@@ -1,0 +1,869 @@
+"""In-memory columnar graph shard + multi-shard facade.
+
+`GraphStore` is one shard: the role of the reference's `Graph` singleton +
+`Node`/`Edge` objects (euler/core/graph/graph.h:41-209, node.h:59-198), but
+columnar and vectorized — every query is a batch query over numpy arrays, so a
+single Python call does the work of thousands of per-record C++ virtual calls.
+Weighted sampling uses prefix-sum + searchsorted (the vectorized equivalent of
+the reference's CompactWeightedCollection binary search, node.h:49-57); global
+per-type samplers match Graph::BuildGlobalSampler (graph.h:133-135).
+
+`Graph` stitches shards together: ids are scattered to their owner shard
+(`id % P`), queried, and gathered back in input order — the batch-API
+equivalent of the reference's SPLIT → REMOTE(shard) → MERGE compiled DAGs
+(euler/parser/optimizer.h:49-86, euler/core/kernels/remote_op.cc:31-36).
+Shard-weighted global sampling mirrors query_proxy.cc:91-144.
+
+All query results are fixed-shape padded arrays (+ boolean masks) so they can
+feed straight into jitted XLA programs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from euler_tpu.graph import format as tformat
+from euler_tpu.graph.meta import BINARY, DENSE, SPARSE, GraphMeta
+
+DEFAULT_ID = np.uint64(0xFFFFFFFFFFFFFFFF)  # padding sentinel for node ids
+
+
+def _rng(rng) -> np.random.Generator:
+    return rng if rng is not None else np.random.default_rng()
+
+
+class _WeightedSampler:
+    """O(log n) vectorized weighted sampling via prefix sums."""
+
+    def __init__(self, weights: np.ndarray):
+        self.cum = np.concatenate(
+            [[0.0], np.cumsum(weights.astype(np.float64))]
+        )
+        self.total = float(self.cum[-1])
+        self.n = len(weights)
+
+    def sample(self, count: int, rng) -> np.ndarray:
+        if self.n == 0 or self.total <= 0:
+            return np.zeros(count, dtype=np.int64)
+        target = _rng(rng).random(count) * self.total
+        return np.clip(
+            np.searchsorted(self.cum, target, side="right") - 1, 0, self.n - 1
+        )
+
+
+class _CSR:
+    """Per-edge-type adjacency with cumulative weights for row sampling."""
+
+    def __init__(self, indptr, dst, w, eidx):
+        self.indptr = np.asarray(indptr)
+        self.dst = np.asarray(dst)
+        self.w = np.asarray(w)
+        self.eidx = np.asarray(eidx)
+        self.cum = np.concatenate([[0.0], np.cumsum(self.w.astype(np.float64))])
+        self._dst_sorted = None  # lazy: within-row dst-sorted view for lookups
+
+    def degrees(self, rows: np.ndarray) -> np.ndarray:
+        return self.indptr[rows + 1] - self.indptr[rows]
+
+    def row_weight(self, rows: np.ndarray) -> np.ndarray:
+        return self.cum[self.indptr[rows + 1]] - self.cum[self.indptr[rows]]
+
+    def sample_in_rows(self, rows: np.ndarray, rng) -> np.ndarray:
+        """One weighted neighbor element index (global) per entry of `rows`."""
+        s, e = self.indptr[rows], self.indptr[rows + 1]
+        lo, hi = self.cum[s], self.cum[e]
+        target = lo + _rng(rng).random(len(rows)) * (hi - lo)
+        j = np.searchsorted(self.cum, target, side="right") - 1
+        return np.clip(j, s, np.maximum(s, e - 1))
+
+    def sorted_dst(self):
+        """(perm, dst_sorted): within-row permutation sorting dst ascending."""
+        if self._dst_sorted is None:
+            rows = np.repeat(
+                np.arange(len(self.indptr) - 1), np.diff(self.indptr)
+            )
+            perm = np.lexsort((self.dst, rows))
+            self._dst_sorted = (perm, self.dst[perm])
+        return self._dst_sorted
+
+    def contains(self, rows: np.ndarray, targets: np.ndarray) -> np.ndarray:
+        """Membership: is targets[i] a neighbor of row rows[i]?"""
+        perm, dsts = self.sorted_dst()
+        s, e = self.indptr[rows], self.indptr[rows + 1]
+        out = np.zeros(len(rows), dtype=bool)
+        # vectorized per-row binary search using global sorted-by-(row,dst) order
+        left = s + _searchsorted_segments(dsts, s, e, targets)
+        ok = left < e
+        out[ok] = dsts[left[ok]] == targets[ok]
+        return out
+
+
+def _searchsorted_segments(sorted_vals, seg_start, seg_end, targets):
+    """For each i, position of targets[i] within sorted_vals[seg_start:seg_end]."""
+    n = len(targets)
+    lo = seg_start.copy()
+    hi = seg_end.copy()
+    while True:
+        active = lo < hi
+        if not active.any():
+            break
+        mid = (lo + hi) // 2
+        less = np.zeros(n, dtype=bool)
+        less[active] = sorted_vals[mid[active]] < targets[active]
+        lo = np.where(active & less, mid + 1, lo)
+        hi = np.where(active & ~less, mid, hi)
+    return lo - seg_start
+
+
+class GraphStore:
+    """One graph shard served from columnar arrays (see builder.py layout)."""
+
+    def __init__(self, meta: GraphMeta, arrays: dict[str, np.ndarray], part: int = 0):
+        self.meta = meta
+        self.part = part
+        self.node_ids = np.asarray(arrays["node_ids"])
+        self.node_types = np.asarray(arrays["node_types"])
+        self.node_weights = np.asarray(arrays["node_weights"])
+        self.num_nodes = len(self.node_ids)
+        self.arrays = arrays
+        self.adj = [
+            _CSR(
+                arrays[f"adj_{t}_indptr"],
+                arrays[f"adj_{t}_dst"],
+                arrays[f"adj_{t}_w"],
+                arrays[f"adj_{t}_eidx"],
+            )
+            for t in range(meta.num_edge_types)
+        ]
+        self.inadj = [
+            _CSR(
+                arrays[f"inadj_{t}_indptr"],
+                arrays[f"inadj_{t}_dst"],
+                arrays[f"inadj_{t}_w"],
+                arrays[f"inadj_{t}_eidx"],
+            )
+            for t in range(meta.num_edge_types)
+            if f"inadj_{t}_indptr" in arrays
+        ]
+        self.edge_src = np.asarray(arrays["edge_src"])
+        self.edge_dst = np.asarray(arrays["edge_dst"])
+        self.edge_types = np.asarray(arrays["edge_types"])
+        self.edge_weights = np.asarray(arrays["edge_weights"])
+        # global per-type samplers (Graph::BuildGlobalSampler parity)
+        self._node_samplers = [
+            _WeightedSampler(
+                np.where(self.node_types == t, self.node_weights, 0.0)
+            )
+            for t in range(meta.num_node_types)
+        ]
+        self._node_sampler_all = _WeightedSampler(self.node_weights)
+        self._edge_samplers = [
+            _WeightedSampler(
+                np.where(self.edge_types == t, self.edge_weights, 0.0)
+            )
+            for t in range(meta.num_edge_types)
+        ]
+        self._edge_sampler_all = _WeightedSampler(self.edge_weights)
+        self._edge_key_index: dict | None = None
+
+    # ---- id resolution -------------------------------------------------
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        """External u64 ids → local rows; -1 for missing (vectorized)."""
+        ids = np.asarray(ids, dtype=np.uint64)
+        if self.num_nodes == 0:
+            return np.full(len(ids), -1, dtype=np.int64)
+        pos = np.searchsorted(self.node_ids, ids)
+        pos = np.clip(pos, 0, self.num_nodes - 1)
+        ok = self.node_ids[pos] == ids
+        return np.where(ok, pos, -1).astype(np.int64)
+
+    # ---- global sampling (api.h:44-52 parity) --------------------------
+
+    def sample_node(self, count: int, node_type: int = -1, rng=None) -> np.ndarray:
+        sampler = (
+            self._node_sampler_all
+            if node_type < 0
+            else self._node_samplers[node_type]
+        )
+        rowz = sampler.sample(count, rng)
+        if sampler.total <= 0:
+            return np.full(count, DEFAULT_ID, dtype=np.uint64)
+        return self.node_ids[rowz]
+
+    def sample_edge(self, count: int, edge_type: int = -1, rng=None) -> np.ndarray:
+        """Returns [count, 3] uint64 rows of (src, dst, type)."""
+        sampler = (
+            self._edge_sampler_all
+            if edge_type < 0
+            else self._edge_samplers[edge_type]
+        )
+        if sampler.total <= 0:
+            return np.full((count, 3), DEFAULT_ID, dtype=np.uint64)
+        rowz = sampler.sample(count, rng)
+        return np.stack(
+            [
+                self.edge_src[rowz],
+                self.edge_dst[rowz],
+                self.edge_types[rowz].astype(np.uint64),
+            ],
+            axis=1,
+        )
+
+    def node_type(self, ids: np.ndarray) -> np.ndarray:
+        rows = self.lookup(ids)
+        out = np.full(len(rows), -1, dtype=np.int32)
+        ok = rows >= 0
+        out[ok] = self.node_types[rows[ok]]
+        return out
+
+    # ---- neighbor queries (node.h:82-112 parity) -----------------------
+
+    def _csrs(self, edge_types, in_edges: bool = False) -> list[_CSR]:
+        table = self.inadj if in_edges else self.adj
+        if edge_types is None:
+            edge_types = range(self.meta.num_edge_types)
+        return [(t, table[t]) for t in edge_types]
+
+    def sample_neighbor(
+        self, ids, edge_types=None, count: int = 10, rng=None, in_edges=False
+    ):
+        """Weighted neighbor sampling with replacement.
+
+        Returns (nbr_ids u64[n,count], weights f32[n,count], types i32[n,count],
+        mask bool[n,count]).
+        """
+        rng = _rng(rng)
+        ids = np.asarray(ids, dtype=np.uint64)
+        rows = self.lookup(ids)
+        n = len(rows)
+        csrs = self._csrs(edge_types, in_edges)
+        safe = np.maximum(rows, 0)
+        # per (node, type) total weights → type choice per draw
+        tot = np.stack([c.row_weight(safe) for _, c in csrs], axis=1)  # [n, T]
+        tot[rows < 0] = 0.0
+        row_total = tot.sum(axis=1)
+        mask_any = row_total > 0
+        cum_t = np.cumsum(tot, axis=1)
+        u = rng.random((n, count)) * row_total[:, None]
+        type_choice = (u[:, :, None] >= cum_t[:, None, :]).sum(axis=2)  # [n,count]
+        type_choice = np.minimum(type_choice, len(csrs) - 1)
+
+        nbr = np.full((n, count), DEFAULT_ID, dtype=np.uint64)
+        w = np.zeros((n, count), dtype=np.float32)
+        tt = np.full((n, count), -1, dtype=np.int32)
+        eidx = np.full((n, count), -1, dtype=np.int64)
+        for k, (t, c) in enumerate(csrs):
+            sel = (type_choice == k) & mask_any[:, None] & (rows >= 0)[:, None]
+            if not sel.any() or len(c.dst) == 0:
+                continue
+            r_sel = np.repeat(safe, count).reshape(n, count)[sel]
+            has = c.degrees(r_sel) > 0
+            j = c.sample_in_rows(r_sel[has], rng)
+            flat = np.zeros(sel.sum(), dtype=np.int64)
+            flat[has] = j
+            vals = np.where(has, c.dst[flat], DEFAULT_ID)
+            nbr[sel] = vals
+            w[sel] = np.where(has, c.w[flat], 0.0).astype(np.float32)
+            tt[sel] = np.where(has, t, -1)
+            eidx[sel] = np.where(has, c.eidx[flat], -1)
+        mask = nbr != DEFAULT_ID
+        return nbr, w, tt, mask, eidx
+
+    def get_full_neighbor(
+        self, ids, edge_types=None, max_degree=None, in_edges=False, sort_by=None
+    ):
+        """Padded full adjacency.
+
+        sort_by: None (storage order) | 'id' | 'weight' (descending, for top-k).
+        Returns (nbr u64[n,D], w f32[n,D], types i32[n,D], mask bool[n,D],
+        eidx i64[n,D]).
+        """
+        ids = np.asarray(ids, dtype=np.uint64)
+        rows = self.lookup(ids)
+        n = len(rows)
+        safe = np.maximum(rows, 0)
+        csrs = self._csrs(edge_types, in_edges)
+        degs = np.stack(
+            [c.degrees(safe) for _, c in csrs], axis=1
+        )  # [n, T]
+        degs[rows < 0] = 0
+        total_deg = degs.sum(axis=1)
+        cap = int(total_deg.max()) if max_degree is None else int(max_degree)
+        cap = max(cap, 1)
+        nbr = np.full((n, cap), DEFAULT_ID, dtype=np.uint64)
+        w = np.zeros((n, cap), dtype=np.float32)
+        tt = np.full((n, cap), -1, dtype=np.int32)
+        eidx = np.full((n, cap), -1, dtype=np.int64)
+        col = np.zeros(n, dtype=np.int64)
+        for k, (t, c) in enumerate(csrs):
+            d = degs[:, k]
+            present = d > 0
+            if not present.any():
+                col += 0
+                continue
+            # element indices per row, flattened
+            reps = d[present]
+            r_idx = np.repeat(np.nonzero(present)[0], reps)
+            starts = c.indptr[safe[present]]
+            offs = np.arange(reps.sum()) - np.repeat(
+                np.cumsum(reps) - reps, reps
+            )
+            src_el = np.repeat(starts, reps) + offs
+            dest_col = np.repeat(col[present], reps) + offs
+            keep = dest_col < cap
+            nbr[r_idx[keep], dest_col[keep]] = c.dst[src_el[keep]]
+            w[r_idx[keep], dest_col[keep]] = c.w[src_el[keep]]
+            tt[r_idx[keep], dest_col[keep]] = t
+            eidx[r_idx[keep], dest_col[keep]] = c.eidx[src_el[keep]]
+            col += d
+        mask = nbr != DEFAULT_ID
+        if sort_by == "id":
+            order = np.argsort(np.where(mask, nbr, DEFAULT_ID), axis=1, kind="stable")
+        elif sort_by == "weight":
+            order = np.argsort(np.where(mask, -w, np.inf), axis=1, kind="stable")
+        else:
+            order = None
+        if order is not None:
+            take = np.take_along_axis
+            nbr = take(nbr, order, 1)
+            w = take(w, order, 1)
+            tt = take(tt, order, 1)
+            eidx = take(eidx, order, 1)
+            mask = take(mask, order, 1)
+        return nbr, w, tt, mask, eidx
+
+    def get_top_k_neighbor(self, ids, edge_types=None, k=10, in_edges=False):
+        nbr, w, tt, mask, eidx = self.get_full_neighbor(
+            ids, edge_types, in_edges=in_edges, sort_by="weight"
+        )
+        pad = max(k - nbr.shape[1], 0)
+        if pad:
+            nbr = np.pad(nbr, ((0, 0), (0, pad)), constant_values=DEFAULT_ID)
+            w = np.pad(w, ((0, 0), (0, pad)))
+            tt = np.pad(tt, ((0, 0), (0, pad)), constant_values=-1)
+            mask = np.pad(mask, ((0, 0), (0, pad)))
+            eidx = np.pad(eidx, ((0, 0), (0, pad)), constant_values=-1)
+        return nbr[:, :k], w[:, :k], tt[:, :k], mask[:, :k], eidx[:, :k]
+
+    # ---- layerwise sampling (API_SAMPLE_L, sample_layer_op.cc:83) ------
+
+    def sample_neighbor_layerwise(
+        self, batch_ids, edge_types=None, count: int = 128, rng=None
+    ):
+        """LADIES-style layer sampling: one candidate set for the whole batch.
+
+        Samples `count` layer nodes ∝ total incident weight from the batch,
+        then returns the batch→layer adjacency restricted to sampled nodes.
+        Returns (layer_ids u64[count], adj f32[n, count], mask bool[count]).
+        """
+        rng = _rng(rng)
+        batch_ids = np.asarray(batch_ids, dtype=np.uint64)
+        nbr, w, _, mask, _ = self.get_full_neighbor(batch_ids, edge_types)
+        flat_ids = nbr[mask]
+        flat_w = w[mask].astype(np.float64)
+        if len(flat_ids) == 0:
+            return (
+                np.full(count, DEFAULT_ID, dtype=np.uint64),
+                np.zeros((len(batch_ids), count), dtype=np.float32),
+                np.zeros(count, dtype=bool),
+            )
+        uniq, inv = np.unique(flat_ids, return_inverse=True)
+        wsum = np.zeros(len(uniq))
+        np.add.at(wsum, inv, flat_w)
+        sampler = _WeightedSampler(wsum)
+        chosen = np.unique(sampler.sample(count, rng))
+        layer = np.full(count, DEFAULT_ID, dtype=np.uint64)
+        layer[: len(chosen)] = uniq[chosen]
+        lmask = layer != DEFAULT_ID
+        # batch → layer adjacency
+        pos = np.searchsorted(uniq[chosen], nbr.ravel())
+        pos = np.clip(pos, 0, len(chosen) - 1)
+        hit = mask.ravel() & (uniq[chosen][pos] == nbr.ravel())
+        adj = np.zeros((len(batch_ids), count), dtype=np.float32)
+        rr = np.repeat(np.arange(len(batch_ids)), nbr.shape[1])
+        np.add.at(adj, (rr[hit], pos[hit]), w.ravel()[hit])
+        return layer, adj, lmask
+
+    # ---- features (node.h:120-145 / feature_ops parity) ----------------
+
+    def _feat(self, prefix: str, kind: str, fid: int, suffix: str = ""):
+        key = {
+            DENSE: f"{prefix}_dense_{fid}",
+            SPARSE: f"{prefix}_sparse_{fid}{suffix}",
+            BINARY: f"{prefix}_bin_{fid}{suffix}",
+        }[kind]
+        return self.arrays[key]
+
+    def get_dense_feature(self, ids, names: list[str]) -> np.ndarray:
+        """[n, sum(dims)] f32; missing nodes → zeros."""
+        rows = self.lookup(ids)
+        return self._dense_by_rows(rows, names, node=True)
+
+    def _dense_by_rows(self, rows, names, node: bool) -> np.ndarray:
+        prefix = "nf" if node else "ef"
+        specs = [self.meta.feature_spec(nm, node=node) for nm in names]
+        cols = []
+        safe = np.maximum(rows, 0)
+        for spec in specs:
+            vals = self._feat(prefix, DENSE, spec.fid)
+            out = np.asarray(vals[safe], dtype=np.float32)
+            out[rows < 0] = 0.0
+            cols.append(out)
+        return np.concatenate(cols, axis=1) if cols else np.zeros((len(rows), 0), np.float32)
+
+    def get_sparse_feature(self, ids, names: list[str], max_len: int | None = None):
+        """Per name: (values u64[n, L], mask bool[n, L])."""
+        rows = self.lookup(ids)
+        return self._varlen_by_rows(rows, names, SPARSE, node=True, max_len=max_len)
+
+    def get_binary_feature(self, ids, names: list[str]) -> list[list[bytes]]:
+        rows = self.lookup(ids)
+        out = []
+        for nm in names:
+            spec = self.meta.feature_spec(nm, node=True)
+            indptr = self._feat("nf", BINARY, spec.fid, "_indptr")
+            blob = self._feat("nf", BINARY, spec.fid, "_values")
+            vals = []
+            for r in rows:
+                if r < 0:
+                    vals.append(b"")
+                else:
+                    vals.append(bytes(blob[indptr[r] : indptr[r + 1]]))
+            out.append(vals)
+        return out
+
+    def _varlen_by_rows(self, rows, names, kind, node: bool, max_len=None):
+        prefix = "nf" if node else "ef"
+        out = []
+        for nm in names:
+            spec = self.meta.feature_spec(nm, node=node)
+            indptr = self._feat(prefix, kind, spec.fid, "_indptr")
+            values = self._feat(prefix, kind, spec.fid, "_values")
+            safe = np.maximum(rows, 0)
+            lens = np.where(rows >= 0, indptr[safe + 1] - indptr[safe], 0)
+            cap = int(max_len) if max_len else max(int(lens.max(initial=0)), 1)
+            vals = np.zeros((len(rows), cap), dtype=values.dtype)
+            mask = np.zeros((len(rows), cap), dtype=bool)
+            for i, r in enumerate(rows):
+                if r < 0:
+                    continue
+                seg = values[indptr[r] : indptr[r + 1]][:cap]
+                vals[i, : len(seg)] = seg
+                mask[i, : len(seg)] = True
+            out.append((vals, mask))
+        return out
+
+    # ---- edge features -------------------------------------------------
+
+    def _edge_rows(self, edge_ids: np.ndarray) -> np.ndarray:
+        """(src,dst,type) triples [n,3] u64 → edge row indices, -1 missing."""
+        if self._edge_key_index is None:
+            self._edge_key_index = {
+                (int(s), int(d), int(t)): i
+                for i, (s, d, t) in enumerate(
+                    zip(self.edge_src, self.edge_dst, self.edge_types)
+                )
+            }
+        return np.asarray(
+            [
+                self._edge_key_index.get((int(s), int(d), int(t)), -1)
+                for s, d, t in np.asarray(edge_ids, dtype=np.uint64)
+            ],
+            dtype=np.int64,
+        )
+
+    def get_edge_dense_feature(self, edge_ids, names: list[str]) -> np.ndarray:
+        rows = self._edge_rows(edge_ids)
+        return self._dense_by_rows(rows, names, node=False)
+
+    def get_edge_sparse_feature(self, edge_ids, names, max_len=None):
+        rows = self._edge_rows(edge_ids)
+        return self._varlen_by_rows(rows, names, SPARSE, node=False, max_len=max_len)
+
+    def get_edge_binary_feature(self, edge_ids, names: list[str]):
+        rows = self._edge_rows(edge_ids)
+        out = []
+        for nm in names:
+            spec = self.meta.feature_spec(nm, node=False)
+            indptr = self._feat("ef", BINARY, spec.fid, "_indptr")
+            blob = self._feat("ef", BINARY, spec.fid, "_values")
+            out.append(
+                [
+                    bytes(blob[indptr[r] : indptr[r + 1]]) if r >= 0 else b""
+                    for r in rows
+                ]
+            )
+        return out
+
+    # ---- graph-label path (whole-graph batches) ------------------------
+
+    def get_graph_by_label(self, label_ids: np.ndarray) -> list[np.ndarray]:
+        indptr = self.arrays["glabel_indptr"]
+        nodes = self.arrays["glabel_nodes"]
+        out = []
+        for li in np.asarray(label_ids, dtype=np.int64):
+            if 0 <= li < len(indptr) - 1:
+                out.append(np.asarray(nodes[indptr[li] : indptr[li + 1]]))
+            else:
+                out.append(np.zeros(0, dtype=np.uint64))
+        return out
+
+    # ---- random walks (random_walk_op.cc:27-90 parity) -----------------
+
+    def random_walk(
+        self,
+        ids,
+        edge_types=None,
+        walk_len: int = 3,
+        p: float = 1.0,
+        q: float = 1.0,
+        rng=None,
+    ) -> np.ndarray:
+        """node2vec walk. Returns u64 [n, walk_len+1]; DEFAULT_ID once stuck."""
+        rng = _rng(rng)
+        ids = np.asarray(ids, dtype=np.uint64)
+        n = len(ids)
+        walks = np.full((n, walk_len + 1), DEFAULT_ID, dtype=np.uint64)
+        walks[:, 0] = ids
+        cur = ids.copy()
+        prev = np.full(n, DEFAULT_ID, dtype=np.uint64)
+        for step in range(1, walk_len + 1):
+            if p == 1.0 and q == 1.0:
+                nbr, _, _, mask, _ = self.sample_neighbor(cur, edge_types, 1, rng)
+                nxt = np.where(mask[:, 0], nbr[:, 0], DEFAULT_ID)
+            else:
+                nxt = self._node2vec_step(cur, prev, edge_types, p, q, rng)
+            dead = cur == DEFAULT_ID
+            nxt[dead] = DEFAULT_ID
+            walks[:, step] = nxt
+            prev, cur = cur, nxt
+        return walks
+
+    def _node2vec_step(self, cur, prev, edge_types, p, q, rng):
+        """One node2vec transition. `prev` may be off-shard: the 1/p return
+        bias works from ids alone; the "distance-1" membership bias needs
+        prev's adjacency and degrades to 1/q when prev is not local."""
+        nbr, w, _, mask, _ = self.get_full_neighbor(cur, edge_types)
+        n, cap = nbr.shape
+        rows = self.lookup(cur)
+        # bias: 1/p back to prev, 1 if nbr adjacent to prev, 1/q else
+        adj_w = w.astype(np.float64).copy()
+        prev = np.asarray(prev, dtype=np.uint64)
+        prev_rows = self.lookup(prev)
+        has_prev = prev != DEFAULT_ID
+        prev_local = prev_rows >= 0
+        flat_prev = np.repeat(np.maximum(prev_rows, 0), cap)
+        flat_nbr = nbr.ravel()
+        is_back = flat_nbr == np.repeat(prev, cap)
+        near = np.zeros(n * cap, dtype=bool)
+        for t, c in self._csrs(edge_types):
+            near |= c.contains(flat_prev, flat_nbr)
+        near &= np.repeat(prev_local, cap)
+        bias = np.where(is_back, 1.0 / p, np.where(near, 1.0, 1.0 / q))
+        bias = np.where(np.repeat(has_prev, cap), bias, 1.0).reshape(n, cap)
+        adj_w *= bias
+        adj_w[~mask] = 0.0
+        tot = adj_w.sum(axis=1)
+        ok = tot > 0
+        r = _rng(rng).random(n) * np.maximum(tot, 1e-30)
+        choice = (r[:, None] >= np.cumsum(adj_w, axis=1)).sum(axis=1)
+        choice = np.minimum(choice, cap - 1)
+        out = np.where(
+            ok & (rows >= 0), nbr[np.arange(n), choice], DEFAULT_ID
+        )
+        return out
+
+
+class Graph:
+    """Multi-shard facade: in-process shards today, RPC shards later.
+
+    This is the single entry point trainers use — the `QueryProxy` of the TPU
+    build (euler/client/query_proxy.h:39-93). All methods accept/return padded
+    numpy batches.
+    """
+
+    def __init__(self, meta: GraphMeta, shards: list[GraphStore]):
+        self.meta = meta
+        self.shards = shards
+        self.num_shards = len(shards)
+        # shard-weighted root sampling (query_proxy.cc:91-144)
+        self._node_shard_w = np.asarray(meta.node_weight_sums, dtype=np.float64)
+        self._edge_shard_w = np.asarray(meta.edge_weight_sums, dtype=np.float64)
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def load(cls, directory: str, mmap: bool = True) -> "Graph":
+        meta = GraphMeta.load(directory)
+        shards = [
+            GraphStore(
+                meta,
+                tformat.read_arrays(os.path.join(directory, f"part_{p}"), mmap),
+                part=p,
+            )
+            for p in range(meta.num_partitions)
+        ]
+        return cls(meta, shards)
+
+    @classmethod
+    def from_json(cls, graph_json, num_partitions: int = 1) -> "Graph":
+        from euler_tpu.graph.builder import build_from_json
+
+        meta, arrays = build_from_json(graph_json, num_partitions)
+        return cls(meta, [GraphStore(meta, a, p) for p, a in enumerate(arrays)])
+
+    # -- scatter/gather helper (SPLIT → REMOTE → MERGE equivalent) -------
+
+    def _owner(self, ids: np.ndarray) -> np.ndarray:
+        return (np.asarray(ids, dtype=np.uint64) % np.uint64(self.num_shards)).astype(
+            np.int64
+        )
+
+    def _scatter_gather(self, ids, fn, extras=()):
+        """fn(shard, sub_ids, *sub_extras) → tuple/array, gathered to input order.
+
+        `extras` are arrays aligned with `ids`, scattered the same way.
+        """
+        ids = np.asarray(ids, dtype=np.uint64)
+        if self.num_shards == 1 or len(ids) == 0:
+            return fn(self.shards[0], ids, *extras)
+        owner = self._owner(ids)
+        parts = []
+        index = []
+        for s in range(self.num_shards):
+            sel = np.nonzero(owner == s)[0]
+            index.append(sel)
+            parts.append(
+                fn(self.shards[s], ids[sel], *[e[sel] for e in extras])
+                if len(sel)
+                else None
+            )
+        # find a template result to size outputs
+        template = next(p for p in parts if p is not None)
+        single = not isinstance(template, tuple)
+        outs = []
+        n = len(ids)
+        arrs = (template,) if single else template
+        for a in arrs:
+            out = np.zeros((n,) + a.shape[1:], dtype=a.dtype)
+            if a.dtype == np.uint64:
+                out[:] = DEFAULT_ID
+            elif a.dtype in (np.int32, np.int64):
+                out[:] = -1
+            outs.append(out)
+        for s, sel in enumerate(index):
+            if parts[s] is None:
+                continue
+            res = (parts[s],) if single else parts[s]
+            for o, a in zip(outs, res):
+                o[sel] = a
+        return outs[0] if single else tuple(outs)
+
+    # -- API surface -----------------------------------------------------
+
+    def sample_node(self, count: int, node_type: int = -1, rng=None) -> np.ndarray:
+        rng = _rng(rng)
+        node_type = self.meta.node_type_id(node_type) if isinstance(node_type, str) else node_type
+        if self.num_shards == 1:
+            return self.shards[0].sample_node(count, node_type, rng)
+        w = (
+            self._node_shard_w.sum(axis=1)
+            if node_type < 0
+            else self._node_shard_w[:, node_type]
+        )
+        picks = _WeightedSampler(w).sample(count, rng)
+        out = np.empty(count, dtype=np.uint64)
+        for s in range(self.num_shards):
+            sel = picks == s
+            if sel.any():
+                out[sel] = self.shards[s].sample_node(int(sel.sum()), node_type, rng)
+        return out
+
+    def sample_edge(self, count: int, edge_type: int = -1, rng=None) -> np.ndarray:
+        rng = _rng(rng)
+        if self.num_shards == 1:
+            return self.shards[0].sample_edge(count, edge_type, rng)
+        w = (
+            self._edge_shard_w.sum(axis=1)
+            if edge_type < 0
+            else self._edge_shard_w[:, edge_type]
+        )
+        picks = _WeightedSampler(w).sample(count, rng)
+        out = np.empty((count, 3), dtype=np.uint64)
+        for s in range(self.num_shards):
+            sel = picks == s
+            if sel.any():
+                out[sel] = self.shards[s].sample_edge(int(sel.sum()), edge_type, rng)
+        return out
+
+    def node_type(self, ids) -> np.ndarray:
+        return self._scatter_gather(ids, lambda sh, i: sh.node_type(i))
+
+    def sample_neighbor(self, ids, edge_types=None, count=10, rng=None, in_edges=False):
+        rng = _rng(rng)
+        return self._scatter_gather(
+            ids,
+            lambda sh, i: sh.sample_neighbor(i, edge_types, count, rng, in_edges),
+        )
+
+    def get_full_neighbor(
+        self, ids, edge_types=None, max_degree=None, in_edges=False, sort_by=None
+    ):
+        if max_degree is None:
+            max_degree = int(self.max_degree(ids, edge_types, in_edges))
+        return self._scatter_gather(
+            ids,
+            lambda sh, i: sh.get_full_neighbor(
+                i, edge_types, max_degree, in_edges, sort_by
+            ),
+        )
+
+    def max_degree(self, ids, edge_types=None, in_edges=False) -> int:
+        degs = self._scatter_gather(
+            ids,
+            lambda sh, i: np.stack(
+                [c.degrees(np.maximum(sh.lookup(i), 0)) for _, c in sh._csrs(edge_types, in_edges)],
+                axis=1,
+            ).sum(axis=1),
+        )
+        return max(int(np.max(degs, initial=0)), 1)
+
+    def get_top_k_neighbor(self, ids, edge_types=None, k=10, in_edges=False):
+        return self._scatter_gather(
+            ids, lambda sh, i: sh.get_top_k_neighbor(i, edge_types, k, in_edges)
+        )
+
+    def sample_fanout(self, ids, edge_types, counts: list[int], rng=None):
+        """Multi-hop fanout (sample_fanout_op.cc semantics, padded).
+
+        Returns list of per-hop (ids, weights, types, mask); hop 0 is the
+        roots with all-True mask. Hop i has shape [len(ids) * prod(counts[:i])].
+        """
+        rng = _rng(rng)
+        ids = np.asarray(ids, dtype=np.uint64)
+        hops = [(ids, np.ones(len(ids), np.float32), self.node_type(ids), np.ones(len(ids), bool))]
+        cur = ids
+        for c in counts:
+            nbr, w, tt, mask, _ = self.sample_neighbor(cur, edge_types, c, rng)
+            cur = nbr.reshape(-1)
+            hops.append((cur, w.reshape(-1), tt.reshape(-1), mask.reshape(-1)))
+        return hops
+
+    def sample_neighbor_layerwise(self, batch_ids, edge_types=None, count=128, rng=None):
+        """Single-shard path for now; multi-shard merges candidate sets."""
+        rng = _rng(rng)
+        if self.num_shards == 1:
+            return self.shards[0].sample_neighbor_layerwise(
+                batch_ids, edge_types, count, rng
+            )
+        per = -(-count // self.num_shards)  # ceil: keep the [count] contract
+        layers, adjs, masks = [], [], []
+        for sh in self.shards:
+            l, a, m = sh.sample_neighbor_layerwise(batch_ids, edge_types, per, rng)
+            layers.append(l)
+            adjs.append(a)
+            masks.append(m)
+        layer = np.concatenate(layers)[:count]
+        adj = np.concatenate(adjs, axis=1)[:, :count]
+        mask = np.concatenate(masks)[:count]
+        if len(layer) < count:  # pad back up if shards under-filled
+            pad = count - len(layer)
+            layer = np.concatenate(
+                [layer, np.full(pad, DEFAULT_ID, dtype=np.uint64)]
+            )
+            adj = np.pad(adj, ((0, 0), (0, pad)))
+            mask = np.concatenate([mask, np.zeros(pad, dtype=bool)])
+        return layer, adj, mask
+
+    def get_dense_feature(self, ids, names) -> np.ndarray:
+        return self._scatter_gather(ids, lambda sh, i: sh.get_dense_feature(i, names))
+
+    def get_sparse_feature(self, ids, names, max_len=None):
+        if max_len is None:
+            max_len = max(
+                self.meta.feature_spec(nm, node=True).dim for nm in names
+            )
+        results = self._scatter_gather(
+            ids,
+            lambda sh, i: tuple(
+                x
+                for pair in sh.get_sparse_feature(i, names, max_len)
+                for x in pair
+            ),
+        )
+        if not isinstance(results, tuple):
+            results = (results,)
+        return [(results[2 * i], results[2 * i + 1]) for i in range(len(names))]
+
+    def get_binary_feature(self, ids, names):
+        ids = np.asarray(ids, dtype=np.uint64)
+        out = [[b""] * len(ids) for _ in names]
+        owner = self._owner(ids)
+        for s in range(self.num_shards):
+            sel = np.nonzero(owner == s)[0]
+            if not len(sel):
+                continue
+            res = self.shards[s].get_binary_feature(ids[sel], names)
+            for fi, vals in enumerate(res):
+                for j, v in zip(sel, vals):
+                    out[fi][j] = v
+        return out
+
+    def get_edge_dense_feature(self, edge_ids, names) -> np.ndarray:
+        edge_ids = np.asarray(edge_ids, dtype=np.uint64)
+        owner = (edge_ids[:, 0] % np.uint64(self.num_shards)).astype(np.int64)
+        n = len(edge_ids)
+        dim = sum(self.meta.feature_spec(nm, node=False).dim for nm in names)
+        out = np.zeros((n, dim), dtype=np.float32)
+        for s in range(self.num_shards):
+            sel = np.nonzero(owner == s)[0]
+            if len(sel):
+                out[sel] = self.shards[s].get_edge_dense_feature(edge_ids[sel], names)
+        return out
+
+    def sample_graph_label(self, count: int, rng=None) -> np.ndarray:
+        """Uniform sample over graph labels; returns label indices i64."""
+        rng = _rng(rng)
+        n = len(self.meta.graph_labels)
+        return rng.integers(0, max(n, 1), size=count)
+
+    def get_graph_by_label(self, label_ids) -> list[np.ndarray]:
+        per_shard = [sh.get_graph_by_label(label_ids) for sh in self.shards]
+        return [
+            np.sort(np.concatenate([ps[i] for ps in per_shard]))
+            for i in range(len(np.asarray(label_ids)))
+        ]
+
+    def random_walk(self, ids, edge_types=None, walk_len=3, p=1.0, q=1.0, rng=None):
+        rng = _rng(rng)
+        if self.num_shards == 1:
+            return self.shards[0].random_walk(ids, edge_types, walk_len, p, q, rng)
+        ids = np.asarray(ids, dtype=np.uint64)
+        n = len(ids)
+        walks = np.full((n, walk_len + 1), DEFAULT_ID, dtype=np.uint64)
+        walks[:, 0] = ids
+        cur = ids.copy()
+        prev = np.full(n, DEFAULT_ID, dtype=np.uint64)
+        for step in range(1, walk_len + 1):
+            if p == 1.0 and q == 1.0:
+                nbr, _, _, mask, _ = self.sample_neighbor(cur, edge_types, 1, rng)
+                nxt = np.where(mask[:, 0], nbr[:, 0], DEFAULT_ID)
+            else:
+                # cross-shard node2vec: step owned by cur's shard; prev id
+                # travels along so the 1/p return bias is exact, while the
+                # distance-1 bias degrades to 1/q when prev is off-shard.
+                nxt = self._scatter_gather(
+                    cur,
+                    lambda sh, i, pv: sh._node2vec_step(
+                        i, pv, edge_types, p, q, rng
+                    ),
+                    extras=(prev,),
+                )
+            nxt = np.asarray(nxt, dtype=np.uint64)
+            nxt[cur == DEFAULT_ID] = DEFAULT_ID
+            walks[:, step] = nxt
+            prev, cur = cur, nxt
+        return walks

@@ -1,0 +1,32 @@
+"""CLI: graph.json → partitioned tensor-dir shards.
+
+Replaces the reference's `python euler/tools/generate_euler_data.py
+graph.json out_dir num_partitions meta` entry point
+(euler/tools/generate_euler_data.py:28-51). Index metadata is not needed:
+the columnar store builds its samplers/indexes at load time.
+
+Usage: python -m euler_tpu.tools.convert graph.json out_dir [num_partitions]
+"""
+
+import sys
+
+from euler_tpu.graph.builder import convert_json
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 2:
+        print(__doc__)
+        return 2
+    graph_json, out_dir = argv[0], argv[1]
+    parts = int(argv[2]) if len(argv) > 2 else 1
+    meta = convert_json(graph_json, out_dir, parts)
+    print(
+        f"wrote {meta.num_partitions} partition(s) to {out_dir}: "
+        f"{meta.num_node_types} node type(s), {meta.num_edge_types} edge type(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,38 @@
+"""Tensor-dir binary format round-trip tests."""
+
+import numpy as np
+
+from euler_tpu.graph import format as tformat
+
+
+def test_roundtrip(tmp_path):
+    arrays = {
+        "a": np.arange(10, dtype=np.int64),
+        "b": np.ones((3, 4), dtype=np.float32) * 2.5,
+        "c": np.asarray([2**63, 5], dtype=np.uint64),
+        "empty": np.zeros((0,), dtype=np.uint8),
+        "m": np.arange(6, dtype=np.int32).reshape(2, 3),
+    }
+    tformat.write_arrays(str(tmp_path / "td"), arrays)
+    back = tformat.read_arrays(str(tmp_path / "td"))
+    assert set(back) == set(arrays)
+    for k in arrays:
+        np.testing.assert_array_equal(back[k], arrays[k])
+        assert back[k].dtype == arrays[k].dtype
+
+
+def test_alignment(tmp_path):
+    arrays = {"x": np.ones(3, dtype=np.uint8), "y": np.ones(5, dtype=np.float64)}
+    tformat.write_arrays(str(tmp_path / "td"), arrays)
+    import json
+
+    idx = json.load(open(tmp_path / "td" / "tensors.json"))["arrays"]
+    for meta in idx:
+        assert meta["offset"] % tformat.ALIGN == 0
+
+
+def test_no_mmap(tmp_path):
+    arrays = {"x": np.arange(4, dtype=np.float32)}
+    tformat.write_arrays(str(tmp_path / "td"), arrays)
+    back = tformat.read_arrays(str(tmp_path / "td"), mmap=False)
+    np.testing.assert_array_equal(back["x"], arrays["x"])

@@ -1,0 +1,178 @@
+"""Graph store query API tests (mirrors euler graph_test/node_test coverage:
+sampling distributions, neighbor queries, feature values — on both the
+single-shard and 2-shard scatter/gather paths)."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import DEFAULT_ID, Graph, convert_json
+
+ALL_IDS = np.arange(1, 7, dtype=np.uint64)
+
+
+@pytest.fixture(params=["graph1", "graph2"])
+def g(request):
+    return request.getfixturevalue(request.param)
+
+
+def test_load_roundtrip(tmp_path, fixture_graph_dict, graph2):
+    convert_json(fixture_graph_dict, str(tmp_path / "g"), num_partitions=2)
+    g = Graph.load(str(tmp_path / "g"))
+    assert g.num_shards == 2
+    np.testing.assert_array_equal(
+        g.node_type(ALL_IDS), graph2.node_type(ALL_IDS)
+    )
+    np.testing.assert_array_equal(
+        g.get_dense_feature(ALL_IDS, ["dense2"]),
+        graph2.get_dense_feature(ALL_IDS, ["dense2"]),
+    )
+
+
+def test_node_type(g):
+    np.testing.assert_array_equal(g.node_type(ALL_IDS), [1, 0, 1, 0, 1, 0])
+    assert g.node_type(np.asarray([999], np.uint64))[0] == -1
+
+
+def test_sample_node_distribution(g, rng):
+    ids = g.sample_node(6000, node_type=-1, rng=rng)
+    assert set(np.unique(ids)) <= set(ALL_IDS.tolist())
+    # node weights are 1..6 → node 6 ~6x more frequent than node 1
+    counts = np.bincount(ids.astype(np.int64), minlength=7)[1:]
+    ratio = counts[5] / max(counts[0], 1)
+    assert 4.0 < ratio < 9.0
+
+
+def test_sample_node_typed(g, rng):
+    ids = g.sample_node(500, node_type=0, rng=rng)
+    assert set(np.unique(ids)) <= {2, 4, 6}
+    ids = g.sample_node(500, node_type=1, rng=rng)
+    assert set(np.unique(ids)) <= {1, 3, 5}
+
+
+def test_sample_edge(g, rng):
+    e = g.sample_edge(400, edge_type=0, rng=rng)
+    assert e.shape == (400, 3)
+    assert set(e[:, 2].tolist()) == {0}
+    e = g.sample_edge(400, edge_type=-1, rng=rng)
+    assert set(e[:, 2].tolist()) == {0, 1}
+
+
+def test_sample_neighbor(g, rng):
+    nbr, w, tt, mask, _ = g.sample_neighbor(ALL_IDS, None, 8, rng=rng)
+    assert nbr.shape == (6, 8)
+    assert mask.all()  # every fixture node has out-edges
+    # node 1 has out-edges to 2 (t0) and 3 (t1)
+    assert set(np.unique(nbr[0])) <= {2, 3}
+    # typed restriction
+    nbr0, _, tt0, m0, _ = g.sample_neighbor(ALL_IDS, [0], 8, rng=rng)
+    assert set(tt0[m0].tolist()) == {0}
+    assert set(np.unique(nbr0[0])) == {2}
+
+
+def test_sample_neighbor_missing(g, rng):
+    nbr, w, tt, mask, _ = g.sample_neighbor(
+        np.asarray([999], np.uint64), None, 4, rng=rng
+    )
+    assert not mask.any()
+    assert (nbr == DEFAULT_ID).all()
+
+
+def test_sample_neighbor_weighted(g, rng):
+    # node 1: nbr 2 weight 2.0 (t0), nbr 3 weight 3.0 (t1) → P(3) = 0.6
+    nbr, _, _, _, _ = g.sample_neighbor(
+        np.asarray([1], np.uint64), None, 4000, rng=rng
+    )
+    frac3 = (nbr == 3).mean()
+    assert 0.55 < frac3 < 0.65
+
+
+def test_get_full_neighbor(g):
+    nbr, w, tt, mask, eidx = g.get_full_neighbor(ALL_IDS)
+    assert mask.sum() == 12  # every edge appears once
+    row0 = set(nbr[0][mask[0]].tolist())
+    assert row0 == {2, 3}
+    # in-edges of node 1: 3→1, 5→1, 6→1
+    nbr_in, _, _, mask_in, _ = g.get_full_neighbor(ALL_IDS, in_edges=True)
+    row1_in = set(nbr_in[0][mask_in[0]].tolist())
+    assert row1_in == {3, 5, 6}
+
+
+def test_top_k_neighbor(g):
+    nbr, w, tt, mask, _ = g.get_top_k_neighbor(ALL_IDS, None, k=1)
+    # node 1's heaviest neighbor is 3 (w=3.0)
+    assert nbr[0, 0] == 3 and w[0, 0] == 3.0
+
+
+def test_sorted_full_neighbor(g):
+    nbr, _, _, mask, _ = g.get_full_neighbor(ALL_IDS, sort_by="id")
+    valid = nbr[0][mask[0]]
+    assert list(valid) == sorted(valid)
+
+
+def test_dense_feature(g):
+    f = g.get_dense_feature(np.asarray([1, 2], np.uint64), ["dense2", "dense3"])
+    np.testing.assert_allclose(
+        f, [[1.1, 1.2, 1.3, 1.4, 1.5], [2.1, 2.2, 2.3, 2.4, 2.5]], rtol=1e-6
+    )
+    # missing id → zeros
+    f = g.get_dense_feature(np.asarray([999], np.uint64), ["dense2"])
+    np.testing.assert_array_equal(f, [[0.0, 0.0]])
+
+
+def test_sparse_feature(g):
+    [(vals, mask)] = g.get_sparse_feature(np.asarray([3, 999], np.uint64), ["sp"])
+    assert vals[0].tolist()[:2] == [31, 32]
+    assert mask[0].sum() == 2 and mask[1].sum() == 0
+
+
+def test_binary_feature(g):
+    [vals] = g.get_binary_feature(np.asarray([4, 999], np.uint64), ["blob"])
+    assert vals == [b"4a", b""]
+
+
+def test_edge_dense_feature(g):
+    eids = np.asarray([[1, 2, 0], [5, 6, 0], [9, 9, 9]], np.uint64)
+    f = g.get_edge_dense_feature(eids, ["e_dense"])
+    np.testing.assert_allclose(f, [[1.2], [5.6], [0.0]], rtol=1e-6)
+
+
+def test_sample_fanout(g, rng):
+    hops = g.sample_fanout(ALL_IDS[:2], None, [3, 2], rng=rng)
+    assert len(hops) == 3
+    ids0, _, _, m0 = hops[0]
+    assert ids0.shape == (2,) and m0.all()
+    ids1, _, _, m1 = hops[1]
+    assert ids1.shape == (6,) and m1.all()
+    ids2, _, _, m2 = hops[2]
+    assert ids2.shape == (12,)
+
+
+def test_graph_label(g, rng):
+    labels = g.sample_graph_label(5, rng=rng)
+    assert ((labels >= 0) & (labels < 2)).all()
+    groups = g.get_graph_by_label(np.asarray([0, 1]))
+    assert groups[0].tolist() == [1, 2, 3]
+    assert groups[1].tolist() == [4, 5, 6]
+
+
+def test_random_walk(g, rng):
+    walks = g.random_walk(ALL_IDS, None, walk_len=3, rng=rng)
+    assert walks.shape == (6, 4)
+    assert (walks[:, 0] == ALL_IDS).all()
+    assert (walks != DEFAULT_ID).all()  # fixture graph has no dead ends
+
+
+def test_random_walk_node2vec(g, rng):
+    walks = g.random_walk(ALL_IDS, None, walk_len=4, p=0.25, q=4.0, rng=rng)
+    assert walks.shape == (6, 5)
+    assert (walks[:, 0] == ALL_IDS).all()
+
+
+def test_layerwise(graph1, rng):
+    layer, adj, mask = graph1.sample_neighbor_layerwise(
+        ALL_IDS[:3], None, count=4, rng=rng
+    )
+    assert layer.shape == (4,) and adj.shape == (3, 4)
+    # adjacency only points at sampled layer nodes
+    assert (adj[:, ~mask] == 0).all()
+    assert adj.sum() > 0
