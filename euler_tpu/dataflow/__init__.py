@@ -1,0 +1,2 @@
+from euler_tpu.dataflow.base import Block, DataFlow, MiniBatch, fanout_block  # noqa: F401
+from euler_tpu.dataflow.sage import FullNeighborDataFlow, SageDataFlow  # noqa: F401

@@ -1,0 +1,100 @@
+"""Padded mini-batch subgraph containers + base dataflow.
+
+The reference's `DataFlow`/`Block` abstraction (tf_euler/python/dataflow/
+base_dataflow.py:23-52) builds *dynamic* subgraphs with `tf.unique`; XLA needs
+static shapes, so the TPU design pads instead (SURVEY.md §7): hop i holds
+exactly batch * prod(fanouts[:i]) node slots, invalid slots carry a mask, and
+every downstream op is a fixed-shape gather/segment op — fusable by XLA and
+trivially shardable along the batch axis of a device mesh.
+
+A `Block` is the bipartite edge set between hop i+1 ("src", the sampled
+neighbors) and hop i ("dst"); node tables are per-hop feature matrices.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import numpy as np
+
+Array = jax.Array
+
+
+@flax.struct.dataclass
+class Block:
+    """Edges from a src node table into a dst node table (one hop)."""
+
+    edge_src: Array  # int32[E] rows into the src hop table
+    edge_dst: Array  # int32[E] rows into the dst hop table
+    edge_w: Array  # f32[E] edge weights (0 where masked)
+    mask: Array  # bool[E] valid-edge mask
+    n_src: int = flax.struct.field(pytree_node=False)
+    n_dst: int = flax.struct.field(pytree_node=False)
+
+
+@flax.struct.dataclass
+class MiniBatch:
+    """One padded multi-hop subgraph batch, ready for device_put.
+
+    feats[i]  — f32[N_i, F] node features of hop i (hop 0 = roots)
+    masks[i]  — bool[N_i] node validity
+    blocks[i] — edges hop i+1 → hop i  (len == num hops)
+    root_idx  — int32[B] root node ids (for embedding lookups / neg sampling)
+    labels    — optional f32[B, L] supervised targets
+    """
+
+    feats: tuple
+    masks: tuple
+    blocks: tuple
+    root_idx: Array
+    labels: Array | None = None
+
+
+class DataFlow:
+    """Base: fetches features/labels; subclasses build the hop structure.
+
+    query(roots) → MiniBatch of numpy arrays (host); training loops
+    device_put them (or feed through an infeed pipeline).
+    """
+
+    def __init__(
+        self,
+        graph,
+        feature_names: list[str],
+        label_feature: str | None = None,
+        label_dim: int | None = None,
+        rng: np.random.Generator | None = None,
+    ):
+        self.graph = graph
+        self.feature_names = list(feature_names)
+        self.label_feature = label_feature
+        self.label_dim = label_dim
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    # -- helpers ---------------------------------------------------------
+
+    def node_feats(self, ids: np.ndarray) -> np.ndarray:
+        if not self.feature_names:
+            return np.zeros((len(ids), 0), dtype=np.float32)
+        return self.graph.get_dense_feature(ids, self.feature_names)
+
+    def labels_of(self, ids: np.ndarray) -> np.ndarray | None:
+        if self.label_feature is None:
+            return None
+        return self.graph.get_dense_feature(ids, [self.label_feature])
+
+    def query(self, roots: np.ndarray) -> MiniBatch:
+        raise NotImplementedError
+
+
+def fanout_block(batch: int, fanout: int, w: np.ndarray, mask: np.ndarray) -> Block:
+    """Block for sampled fanout: src j feeds dst j // fanout."""
+    e = batch * fanout
+    return Block(
+        edge_src=np.arange(e, dtype=np.int32),
+        edge_dst=np.repeat(np.arange(batch, dtype=np.int32), fanout),
+        edge_w=w.reshape(-1).astype(np.float32),
+        mask=mask.reshape(-1),
+        n_src=e,
+        n_dst=batch,
+    )

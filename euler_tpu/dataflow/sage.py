@@ -1,0 +1,102 @@
+"""Sampled-fanout dataflow (GraphSAGE) — SageDataFlow parity
+(tf_euler/python/dataflow/sage_dataflow.py:35-50) with padded static shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from euler_tpu.dataflow.base import DataFlow, MiniBatch, fanout_block
+from euler_tpu.graph.store import DEFAULT_ID
+
+
+class SageDataFlow(DataFlow):
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        edge_types=None,
+        fanouts=(10, 10),
+        label_feature=None,
+        label_dim=None,
+        rng=None,
+    ):
+        super().__init__(graph, feature_names, label_feature, label_dim, rng)
+        self.edge_types = edge_types
+        self.fanouts = list(fanouts)
+
+    @property
+    def num_hops(self) -> int:
+        return len(self.fanouts)
+
+    def query(self, roots: np.ndarray) -> MiniBatch:
+        roots = np.asarray(roots, dtype=np.uint64)
+        batch = len(roots)
+        hop_ids = [roots]
+        hop_masks = [roots != DEFAULT_ID]
+        blocks = []
+        cur = roots
+        for k in self.fanouts:
+            nbr, w, _, mask, _ = self.graph.sample_neighbor(
+                cur, self.edge_types, k, rng=self.rng
+            )
+            blocks.append(fanout_block(len(cur), k, w, mask))
+            cur = nbr.reshape(-1)
+            hop_ids.append(cur)
+            hop_masks.append(mask.reshape(-1))
+        # padded slots hold DEFAULT_ID → feature fetch returns zeros
+        feats = tuple(self.node_feats(ids) for ids in hop_ids)
+        return MiniBatch(
+            feats=feats,
+            masks=tuple(hop_masks),
+            blocks=tuple(blocks),
+            root_idx=roots.astype(np.int64).astype(np.int32),
+            labels=self.labels_of(roots),
+        )
+
+
+class FullNeighborDataFlow(DataFlow):
+    """Full-neighbor dataflow (GCNDataFlow parity) with a degree cap.
+
+    Every hop expands each node to its full (capped) neighbor list; the cap
+    keeps shapes static — the padded analog of gcn_dataflow.py.
+    """
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        edge_types=None,
+        num_hops=2,
+        max_degree=32,
+        label_feature=None,
+        label_dim=None,
+        rng=None,
+    ):
+        super().__init__(graph, feature_names, label_feature, label_dim, rng)
+        self.edge_types = edge_types
+        self.num_hops = num_hops
+        self.max_degree = max_degree
+
+    def query(self, roots: np.ndarray) -> MiniBatch:
+        roots = np.asarray(roots, dtype=np.uint64)
+        hop_ids = [roots]
+        hop_masks = [roots != DEFAULT_ID]
+        blocks = []
+        cur = roots
+        for _ in range(self.num_hops):
+            nbr, w, _, mask, _ = self.graph.get_full_neighbor(
+                cur, self.edge_types, max_degree=self.max_degree
+            )
+            blocks.append(fanout_block(len(cur), self.max_degree, w, mask))
+            cur = nbr.reshape(-1)
+            hop_ids.append(cur)
+            hop_masks.append(mask.reshape(-1))
+        feats = tuple(self.node_feats(ids) for ids in hop_ids)
+        return MiniBatch(
+            feats=feats,
+            masks=tuple(hop_masks),
+            blocks=tuple(blocks),
+            root_idx=roots.astype(np.int64).astype(np.int32),
+            labels=self.labels_of(roots),
+        )

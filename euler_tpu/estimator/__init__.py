@@ -1,0 +1,9 @@
+from euler_tpu.estimator.estimator import (  # noqa: F401
+    Estimator,
+    EstimatorConfig,
+    edge_batches,
+    id_batches,
+    make_optimizer,
+    node_batches,
+    unsupervised_batches,
+)

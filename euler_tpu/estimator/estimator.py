@@ -1,0 +1,287 @@
+"""Train/evaluate/infer driver — the reference's `BaseEstimator`
+(euler_estimator/python/base_estimator.py:28-188) rebuilt JAX-style.
+
+The model contract matches the reference (mp_utils/base.py:24-95): a flax
+module whose __call__ returns (embedding, loss, metric_name, metric). Batches
+come from host-side generator functions (graph sampling + dataflow queries),
+get device_put, and run through one jitted update step. Checkpointing is
+Orbax; inference writes embedding_{worker}.npy / ids_{worker}.npy like
+base_estimator.py:157-179.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Callable, Iterable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+@dataclasses.dataclass
+class EstimatorConfig:
+    model_dir: str = "/tmp/euler_tpu_model"
+    batch_size: int = 32
+    total_steps: int = 100
+    learning_rate: float = 0.01
+    optimizer: str = "adam"  # adam | adagrad | sgd | momentum
+    momentum: float = 0.9
+    log_steps: int = 20
+    checkpoint_steps: int = 0  # 0 = only at end
+    seed: int = 0
+
+
+def make_optimizer(cfg: EstimatorConfig) -> optax.GradientTransformation:
+    """Optimizer factory (tf_euler/python/utils/optimizers.py parity)."""
+    if cfg.optimizer == "adam":
+        return optax.adam(cfg.learning_rate)
+    if cfg.optimizer == "adagrad":
+        return optax.adagrad(cfg.learning_rate)
+    if cfg.optimizer == "sgd":
+        return optax.sgd(cfg.learning_rate)
+    if cfg.optimizer == "momentum":
+        return optax.sgd(cfg.learning_rate, momentum=cfg.momentum)
+    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+
+
+class Estimator:
+    """Drives a (emb, loss, metric_name, metric) flax model.
+
+    batch_fn() must return a *tuple* of pytrees passed as model args —
+    (MiniBatch,) for supervised heads, (src, pos, negs) for unsupervised.
+    """
+
+    def __init__(
+        self,
+        model,
+        batch_fn: Callable[[], tuple],
+        cfg: EstimatorConfig | None = None,
+    ):
+        self.model = model
+        self.batch_fn = batch_fn
+        self.cfg = cfg or EstimatorConfig()
+        self.params = None
+        self.opt_state = None
+        self.step = 0
+        self.tx = make_optimizer(self.cfg)
+        self._jit_train = None
+        self._jit_eval = None
+        self._jit_embed = None
+
+    # -- state -----------------------------------------------------------
+
+    def _ensure_init(self):
+        if self.params is not None:
+            return
+        batch = self.batch_fn()
+        key = jax.random.PRNGKey(self.cfg.seed)
+        self.params = self.model.init(key, *batch)
+        self.opt_state = self.tx.init(self.params)
+
+    def _train_step(self):
+        if self._jit_train is None:
+
+            @jax.jit
+            def train_step(params, opt_state, *batch):
+                def loss_fn(p):
+                    _, loss, _, metric = self.model.apply(p, *batch)
+                    return loss, metric
+
+                (loss, metric), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                updates, opt_state = self.tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss, metric
+
+            self._jit_train = train_step
+        return self._jit_train
+
+    # -- drivers (train/evaluate/infer/train_and_evaluate) ---------------
+
+    def train(self, total_steps: int | None = None, log: bool = True):
+        self._ensure_init()
+        steps = total_steps if total_steps is not None else self.cfg.total_steps
+        step_fn = self._train_step()
+        t0 = time.time()
+        history = []
+        for _ in range(steps):
+            batch = self.batch_fn()
+            self.params, self.opt_state, loss, metric = step_fn(
+                self.params, self.opt_state, *batch
+            )
+            self.step += 1
+            if log and self.step % self.cfg.log_steps == 0:
+                loss_v = float(loss)
+                dt = time.time() - t0
+                print(
+                    f"step {self.step}: loss={loss_v:.4f} "
+                    f"metric={float(metric):.4f} ({self.step / dt:.1f} it/s)"
+                )
+            history.append(float(loss))
+            if (
+                self.cfg.checkpoint_steps
+                and self.step % self.cfg.checkpoint_steps == 0
+            ):
+                self.save()
+        self.save()
+        return history
+
+    def evaluate(self, batches: Iterable[tuple]) -> dict:
+        self._ensure_init()
+        if self._jit_eval is None:
+            self._jit_eval = jax.jit(
+                lambda p, *b: self.model.apply(p, *b)[1:4:2]
+            )  # (loss, metric)
+        name = None
+        losses, metrics = [], []
+        for batch in batches:
+            loss, metric = self._jit_eval(self.params, *batch)
+            if name is None:
+                name = self.model.apply(self.params, *batch)[2]
+            losses.append(float(loss))
+            metrics.append(float(metric))
+        return {
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            (name or "metric"): float(np.mean(metrics)) if metrics else float("nan"),
+        }
+
+    def infer(
+        self, batches: Iterable[tuple], ids: Iterable[np.ndarray], worker: int = 0
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Embeds batches; writes embedding_{worker}.npy + ids_{worker}.npy."""
+        self._ensure_init()
+        if self._jit_embed is None:
+            self._jit_embed = jax.jit(
+                lambda p, b: self.model.apply(p, b, method=self.model.embed)
+            )
+        embs, all_ids = [], []
+        for batch, chunk_ids in zip(batches, ids):
+            emb = np.asarray(self._jit_embed(self.params, batch[0]))
+            embs.append(emb[: len(chunk_ids)])
+            all_ids.append(np.asarray(chunk_ids))
+        emb = np.concatenate(embs) if embs else np.zeros((0, 0))
+        idv = np.concatenate(all_ids) if all_ids else np.zeros((0,), np.uint64)
+        os.makedirs(self.cfg.model_dir, exist_ok=True)
+        np.save(os.path.join(self.cfg.model_dir, f"embedding_{worker}.npy"), emb)
+        np.save(os.path.join(self.cfg.model_dir, f"ids_{worker}.npy"), idv)
+        return idv, emb
+
+    def train_and_evaluate(self, eval_batches_fn, eval_every: int):
+        """Alternate train/eval (base_estimator train_and_evaluate parity)."""
+        results = []
+        remaining = self.cfg.total_steps
+        while remaining > 0:
+            chunk = min(eval_every, remaining)
+            self.train(chunk)
+            results.append(self.evaluate(eval_batches_fn()))
+            remaining -= chunk
+        return results
+
+    # -- checkpointing (Orbax) -------------------------------------------
+
+    def save(self):
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(os.path.abspath(self.cfg.model_dir), "ckpt")
+        ckpt = ocp.PyTreeCheckpointer()
+        ckpt.save(
+            path,
+            {"params": self.params, "step": self.step},
+            force=True,
+        )
+
+    def restore(self):
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(os.path.abspath(self.cfg.model_dir), "ckpt")
+        if not os.path.exists(path):
+            return False
+        self._ensure_init()
+        ckpt = ocp.PyTreeCheckpointer()
+        restored = ckpt.restore(path, item={"params": self.params, "step": 0})
+        self.params = restored["params"]
+        self.step = int(restored["step"])
+        self.opt_state = self.tx.init(self.params)
+        return True
+
+
+# ---- batch sources (Node/Edge estimator input_fn parity) ----------------
+
+
+def node_batches(
+    graph, flow, batch_size: int, node_type: int = -1, rng=None
+) -> Callable[[], tuple]:
+    """Training source: sample root nodes per step
+    (node_estimator.py:31-37)."""
+    rng = rng if rng is not None else np.random.default_rng()
+
+    def fn():
+        roots = graph.sample_node(batch_size, node_type, rng=rng)
+        return (flow.query(roots),)
+
+    return fn
+
+
+def edge_batches(
+    graph, flow, batch_size: int, edge_type: int = -1, rng=None
+) -> Callable[[], tuple]:
+    """Training source over sampled edges: returns src-node batches with the
+    dst id as positive context (edge_estimator parity)."""
+    rng = rng if rng is not None else np.random.default_rng()
+
+    def fn():
+        edges = graph.sample_edge(batch_size, edge_type, rng=rng)
+        return (flow.query(edges[:, 0]), flow.query(edges[:, 1]))
+
+    return fn
+
+
+def unsupervised_batches(
+    graph,
+    flow,
+    batch_size: int,
+    node_type: int = -1,
+    edge_types=None,
+    num_negs: int = 5,
+    neg_type: int = -1,
+    rng=None,
+) -> Callable[[], tuple]:
+    """(src, pos, negs) source for UnsuperviseModel (mp_utils/base.py:52-95):
+    pos = sampled 1-hop neighbor of src, negs = globally sampled nodes."""
+    rng = rng if rng is not None else np.random.default_rng()
+
+    def fn():
+        src = graph.sample_node(batch_size, node_type, rng=rng)
+        nbr, _, _, mask, _ = graph.sample_neighbor(src, edge_types, 1, rng=rng)
+        pos = np.where(mask[:, 0], nbr[:, 0], src)
+        negs = graph.sample_node(batch_size * num_negs, neg_type, rng=rng)
+        return (flow.query(src), flow.query(pos), flow.query(negs))
+
+    return fn
+
+
+def id_batches(
+    flow, ids: np.ndarray, batch_size: int
+) -> tuple[Iterator[tuple], Iterator[np.ndarray]]:
+    """Fixed-id evaluation/inference source (chunked, last chunk padded)."""
+    ids = np.asarray(ids, dtype=np.uint64)
+
+    def batches():
+        for i in range(0, len(ids), batch_size):
+            chunk = ids[i : i + batch_size]
+            if len(chunk) < batch_size:  # pad to keep shapes static
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], batch_size - len(chunk))]
+                )
+            yield (flow.query(chunk),)
+
+    def id_chunks():
+        for i in range(0, len(ids), batch_size):
+            yield ids[i : i + batch_size]
+
+    return batches(), id_chunks()

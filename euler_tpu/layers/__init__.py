@@ -1,0 +1,31 @@
+from euler_tpu.layers.conv import (  # noqa: F401
+    AGNNConv,
+    APPNPConv,
+    Conv,
+    GATConv,
+    GCNConv,
+    GINConv,
+    GraphConv,
+    SAGEConv,
+    SGCNConv,
+    TAGConv,
+    degrees,
+)
+
+CONVS = {
+    "gcn": GCNConv,
+    "sage": SAGEConv,
+    "gat": GATConv,
+    "gin": GINConv,
+    "graph": GraphConv,
+    "appnp": APPNPConv,
+    "sgcn": SGCNConv,
+    "tagcn": TAGConv,
+    "agnn": AGNNConv,
+}
+
+
+def get_conv(name: str):
+    if name not in CONVS:
+        raise KeyError(f"unknown conv {name!r}; have {sorted(CONVS)}")
+    return CONVS[name]

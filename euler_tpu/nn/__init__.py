@@ -1,0 +1,3 @@
+from euler_tpu.nn import metrics  # noqa: F401
+from euler_tpu.nn.base_gnn import GNNNet, JKGNNNet  # noqa: F401
+from euler_tpu.nn.heads import SuperviseModel, UnsuperviseModel  # noqa: F401

@@ -1,0 +1,65 @@
+"""Metrics (tf_euler/python/utils/metrics.py:23-97 parity): accuracy, f1,
+auc, mrr, mr, hit@k — all as pure jittable functions."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy(labels, predictions) -> jnp.ndarray:
+    """Exact-match accuracy over hard predictions."""
+    return jnp.mean((predictions == labels).astype(jnp.float32))
+
+
+def micro_f1(labels, logits, threshold: float = 0.0) -> jnp.ndarray:
+    """Micro-averaged F1 for multi-label sigmoid heads (metrics.py f1)."""
+    preds = (logits > threshold).astype(jnp.float32)
+    labels = labels.astype(jnp.float32)
+    tp = jnp.sum(preds * labels)
+    fp = jnp.sum(preds * (1 - labels))
+    fn = jnp.sum((1 - preds) * labels)
+    return 2 * tp / jnp.maximum(2 * tp + fp + fn, 1e-9)
+
+
+def auc(labels, scores) -> jnp.ndarray:
+    """Pairwise-ranking AUC (probability a positive outranks a negative)."""
+    labels = labels.reshape(-1).astype(jnp.float32)
+    scores = scores.reshape(-1)
+    pos = labels > 0.5
+    diff = scores[:, None] - scores[None, :]
+    pair = pos[:, None] & ~pos[None, :]
+    wins = jnp.where(pair, (diff > 0) + 0.5 * (diff == 0), 0.0)
+    return jnp.sum(wins) / jnp.maximum(jnp.sum(pair), 1)
+
+
+def ranks_from_scores(pos_scores, neg_scores) -> jnp.ndarray:
+    """Rank of each positive among its negatives (1-based).
+
+    pos_scores: [B] ; neg_scores: [B, N].
+    """
+    better = jnp.sum((neg_scores > pos_scores[:, None]).astype(jnp.float32), -1)
+    ties = jnp.sum((neg_scores == pos_scores[:, None]).astype(jnp.float32), -1)
+    return 1.0 + better + 0.5 * ties
+
+
+def mrr(pos_scores, neg_scores) -> jnp.ndarray:
+    return jnp.mean(1.0 / ranks_from_scores(pos_scores, neg_scores))
+
+
+def mean_rank(pos_scores, neg_scores) -> jnp.ndarray:
+    return jnp.mean(ranks_from_scores(pos_scores, neg_scores))
+
+
+def hit_at_k(pos_scores, neg_scores, k: int) -> jnp.ndarray:
+    return jnp.mean(
+        (ranks_from_scores(pos_scores, neg_scores) <= k).astype(jnp.float32)
+    )
+
+
+METRICS = {
+    "acc": accuracy,
+    "f1": micro_f1,
+    "auc": auc,
+    "mrr": mrr,
+    "mr": mean_rank,
+}

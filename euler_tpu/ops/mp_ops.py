@@ -1,0 +1,145 @@
+"""Device-side message-passing primitives.
+
+The TPU equivalent of the reference's MPGather/MPScatter* TF custom ops
+(tf_euler/python/euler_ops/mp_ops.py:27-79, tf_euler/kernels/scatter_op.cc).
+Everything is expressed over *static-shape* segment operations so XLA can fuse
+the gather → elementwise → segment-reduce chain into the surrounding matmuls.
+
+Padding convention: dataflows route padded edges to valid-looking indices and
+pass `mask`; masked lanes contribute the reduction identity (0 for add/mean,
+-inf for max, zero probability for softmax).
+
+Gradient parity with the reference:
+  - gather ↔ scatter_add adjoints (mp_ops.py:39-49)
+  - scatter_max splits the subgradient equally among argmax ties
+    (mp_ops.py:52-62)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def gather(params: Array, indices: Array) -> Array:
+    """params[indices] along axis 0 (MPGather)."""
+    return jnp.take(params, indices, axis=0)
+
+
+def _masked(data: Array, mask: Array | None, fill) -> Array:
+    if mask is None:
+        return data
+    shape = mask.shape + (1,) * (data.ndim - mask.ndim)
+    return jnp.where(mask.reshape(shape), data, fill)
+
+
+def scatter_add(
+    data: Array,
+    segment_ids: Array,
+    num_segments: int,
+    mask: Array | None = None,
+) -> Array:
+    """Sum `data` rows into `num_segments` rows (MPScatterAdd)."""
+    return jax.ops.segment_sum(
+        _masked(data, mask, 0), segment_ids, num_segments=num_segments
+    )
+
+
+def scatter_mean(
+    data: Array,
+    segment_ids: Array,
+    num_segments: int,
+    mask: Array | None = None,
+) -> Array:
+    """Segment mean; empty segments yield 0 (scatter_mean, mp_ops.py:65-69)."""
+    total = scatter_add(data, segment_ids, num_segments, mask)
+    ones = jnp.ones(data.shape[:1], dtype=data.dtype)
+    if mask is not None:
+        ones = jnp.where(mask, ones, 0)
+    count = jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+    count = jnp.maximum(count, 1)
+    return total / count.reshape((num_segments,) + (1,) * (data.ndim - 1))
+
+
+@jax.custom_vjp
+def _segment_max(data, segment_ids, num_segments):
+    return jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+
+
+def _segment_max_fwd(data, segment_ids, num_segments):
+    out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    return out, (data, segment_ids, num_segments, out)
+
+
+def _segment_max_bwd(res, g):
+    data, segment_ids, num_segments, out = res
+    picked = gather(out, segment_ids)
+    ties = (data == picked).astype(data.dtype)
+    counts = jax.ops.segment_sum(ties, segment_ids, num_segments=num_segments)
+    counts = jnp.maximum(counts, 1)
+    # equal split among argmax ties (scatter_op.cc:66-78 / mp_ops.py:52-62)
+    dd = ties * gather(g / counts, segment_ids)
+    return dd, None, None
+
+
+_segment_max.defvjp(_segment_max_fwd, _segment_max_bwd)
+
+
+def scatter_max(
+    data: Array,
+    segment_ids: Array,
+    num_segments: int,
+    mask: Array | None = None,
+    empty_value: float = 0.0,
+) -> Array:
+    """Segment max; ties split the gradient equally (MPScatterMax).
+
+    Empty segments produce `empty_value` (the reference fills a large
+    negative then replaces; we expose the fill directly).
+    """
+    neg = jnp.finfo(data.dtype).min
+    filled = _masked(data, mask, neg)
+    out = _segment_max(filled, segment_ids, num_segments)
+    # empty segments surface as -inf (segment_max identity) or as the mask
+    # fill; both are <= finfo.min
+    return jnp.where(out <= neg, jnp.asarray(empty_value, out.dtype), out)
+
+
+def scatter_softmax(
+    data: Array,
+    segment_ids: Array,
+    num_segments: int,
+    mask: Array | None = None,
+) -> Array:
+    """Per-segment softmax over rows (scatter_softmax, mp_ops.py:71-79).
+
+    Returns an array shaped like `data`: each row's probability within its
+    segment. Masked rows get probability 0.
+    """
+    neg = jnp.finfo(data.dtype).min
+    filled = _masked(data, mask, neg)
+    seg_max = jax.ops.segment_max(filled, segment_ids, num_segments=num_segments)
+    seg_max = jnp.where(seg_max <= neg, 0.0, seg_max)
+    shifted = filled - gather(seg_max, segment_ids)
+    expd = jnp.exp(shifted)
+    if mask is not None:
+        shape = mask.shape + (1,) * (data.ndim - mask.ndim)
+        expd = jnp.where(mask.reshape(shape), expd, 0.0)
+    denom = jax.ops.segment_sum(expd, segment_ids, num_segments=num_segments)
+    denom = jnp.maximum(denom, jnp.finfo(data.dtype).tiny)
+    return expd / gather(denom, segment_ids)
+
+
+def scatter(op: str, data, segment_ids, num_segments, mask=None):
+    """Dispatch by name ('add' | 'mean' | 'max' | 'softmax') — the string
+    interface the reference's aggregators use (mp_ops.scatter_)."""
+    fns = {
+        "add": scatter_add,
+        "sum": scatter_add,
+        "mean": scatter_mean,
+        "max": scatter_max,
+        "softmax": scatter_softmax,
+    }
+    return fns[op](data, segment_ids, num_segments, mask=mask)

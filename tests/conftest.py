@@ -6,12 +6,19 @@ against 8 virtual CPU devices; real-TPU benchmarking lives in bench.py only.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# The axon TPU-tunnel sitecustomize pins jax_platforms="axon,cpu" at
+# interpreter start; a plain env var cannot override it after that, so tests
+# would silently run through the TPU tunnel. Force CPU at the config level.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
