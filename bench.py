@@ -1,0 +1,100 @@
+"""Headline benchmark: sampled edges/sec training GraphSAGE on one chip.
+
+Trains supervised GraphSAGE (fanout sampling + mean-aggregator convs) on a
+synthetic random graph, with host-side sampling prefetched on worker threads
+overlapping the jitted device step. Metric matches the north star in
+BASELINE.json: sampled edges/sec/chip (target 2M on v5e).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "edges/s", "vs_baseline": N/2e6}
+
+Usage: python bench.py [--smoke]   (--smoke: tiny sizes, forced CPU)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+SMOKE = "--smoke" in sys.argv
+BASELINE_EDGES_PER_SEC = 2_000_000.0
+
+
+def main():
+    if SMOKE:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.datasets.synthetic import random_graph
+    from euler_tpu.estimator import Estimator, EstimatorConfig
+    from euler_tpu.estimator.prefetch import Prefetcher
+    from euler_tpu.models import GraphSAGESupervised
+
+    if SMOKE:
+        num_nodes, out_degree, feat_dim = 2000, 10, 16
+        batch_size, fanouts, dims = 64, [5, 5], [32, 32]
+        warmup, steps = 2, 8
+    else:
+        num_nodes, out_degree, feat_dim = 200_000, 15, 64
+        batch_size, fanouts, dims = 512, [10, 10], [128, 128]
+        warmup, steps = 5, 30
+
+    rng = np.random.default_rng(0)
+    graph = random_graph(
+        num_nodes=num_nodes, out_degree=out_degree, feat_dim=feat_dim, seed=0
+    )
+    flow = SageDataFlow(
+        graph, ["feat"], fanouts=fanouts, label_feature="label", rng=rng
+    )
+    model = GraphSAGESupervised(dims=dims, label_dim=2)
+
+    def batch_fn():
+        roots = graph.sample_node(batch_size, rng=np.random.default_rng())
+        return (flow.query(roots),)
+
+    prefetch = Prefetcher(batch_fn, depth=6, workers=4)
+    est = Estimator(
+        model,
+        prefetch,
+        EstimatorConfig(
+            model_dir="/tmp/euler_tpu_bench",
+            learning_rate=0.01,
+            log_steps=10**9,
+        ),
+    )
+
+    # edges sampled per step: every hop's sample_neighbor draws
+    edges_per_step = 0
+    width = batch_size
+    for k in fanouts:
+        edges_per_step += width * k
+        width *= k
+
+    est.train(total_steps=warmup, log=False, save=False)  # compile + warm
+    t0 = time.perf_counter()
+    est.train(total_steps=steps, log=False, save=False)
+    jax.block_until_ready(est.params)
+    dt = time.perf_counter() - t0
+    prefetch.close()
+
+    value = steps * edges_per_step / dt
+    print(
+        json.dumps(
+            {
+                "metric": "graphsage_sampled_edges_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "edges/s",
+                "vs_baseline": round(value / BASELINE_EDGES_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,7 @@ class MiniBatch:
     blocks: tuple
     root_idx: Array
     labels: Array | None = None
+    hop_ids: tuple | None = None  # int32 per-hop node ids (for id embeddings)
 
 
 class DataFlow:

@@ -52,6 +52,9 @@ class SageDataFlow(DataFlow):
             blocks=tuple(blocks),
             root_idx=roots.astype(np.int64).astype(np.int32),
             labels=self.labels_of(roots),
+            hop_ids=tuple(
+                ids.astype(np.int64).astype(np.int32) for ids in hop_ids
+            ),
         )
 
 
@@ -99,4 +102,7 @@ class FullNeighborDataFlow(DataFlow):
             blocks=tuple(blocks),
             root_idx=roots.astype(np.int64).astype(np.int32),
             labels=self.labels_of(roots),
+            hop_ids=tuple(
+                ids.astype(np.int64).astype(np.int32) for ids in hop_ids
+            ),
         )

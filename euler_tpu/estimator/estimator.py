@@ -60,10 +60,12 @@ class Estimator:
         model,
         batch_fn: Callable[[], tuple],
         cfg: EstimatorConfig | None = None,
+        mesh=None,
     ):
         self.model = model
         self.batch_fn = batch_fn
         self.cfg = cfg or EstimatorConfig()
+        self.mesh = mesh  # jax.sharding.Mesh → data-parallel + sharded tables
         self.params = None
         self.opt_state = None
         self.step = 0
@@ -74,12 +76,28 @@ class Estimator:
 
     # -- state -----------------------------------------------------------
 
+    def _put(self, batch):
+        if self.mesh is None:
+            return batch
+        from euler_tpu.parallel import shard_batch
+
+        return shard_batch(batch, self.mesh)
+
     def _ensure_init(self):
         if self.params is not None:
             return
-        batch = self.batch_fn()
+        import flax.linen as nn
+
+        batch = self._put(self.batch_fn())
         key = jax.random.PRNGKey(self.cfg.seed)
-        self.params = self.model.init(key, *batch)
+        params = self.model.init(key, *batch)
+        if self.mesh is not None:
+            from euler_tpu.parallel import unbox_and_shard
+
+            params, _ = unbox_and_shard(self.mesh, params)
+        else:
+            params = nn.meta.unbox(params)
+        self.params = params
         self.opt_state = self.tx.init(self.params)
 
     def _train_step(self):
@@ -103,14 +121,16 @@ class Estimator:
 
     # -- drivers (train/evaluate/infer/train_and_evaluate) ---------------
 
-    def train(self, total_steps: int | None = None, log: bool = True):
+    def train(
+        self, total_steps: int | None = None, log: bool = True, save: bool = True
+    ):
         self._ensure_init()
         steps = total_steps if total_steps is not None else self.cfg.total_steps
         step_fn = self._train_step()
         t0 = time.time()
         history = []
         for _ in range(steps):
-            batch = self.batch_fn()
+            batch = self._put(self.batch_fn())
             self.params, self.opt_state, loss, metric = step_fn(
                 self.params, self.opt_state, *batch
             )
@@ -128,7 +148,8 @@ class Estimator:
                 and self.step % self.cfg.checkpoint_steps == 0
             ):
                 self.save()
-        self.save()
+        if save:
+            self.save()
         return history
 
     def evaluate(self, batches: Iterable[tuple]) -> dict:
@@ -140,6 +161,7 @@ class Estimator:
         name = None
         losses, metrics = [], []
         for batch in batches:
+            batch = self._put(batch)
             loss, metric = self._jit_eval(self.params, *batch)
             if name is None:
                 name = self.model.apply(self.params, *batch)[2]
@@ -161,6 +183,7 @@ class Estimator:
             )
         embs, all_ids = [], []
         for batch, chunk_ids in zip(batches, ids):
+            batch = self._put(batch)
             emb = np.asarray(self._jit_embed(self.params, batch[0]))
             embs.append(emb[: len(chunk_ids)])
             all_ids.append(np.asarray(chunk_ids))

@@ -1,0 +1,63 @@
+"""Host-side batch prefetching: overlap graph sampling with device compute.
+
+The reference hides sampling latency with async TF ops on a client thread
+pool (query_proxy.cc:205-256); the TPU equivalent is a producer thread (or
+pool) keeping a bounded queue of ready MiniBatches ahead of the device step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+
+class Prefetcher:
+    """Wraps batch_fn() in N producer threads + a bounded queue."""
+
+    def __init__(
+        self, batch_fn: Callable[[], tuple], depth: int = 4, workers: int = 2
+    ):
+        self.batch_fn = batch_fn
+        self.q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._produce, daemon=True)
+            for _ in range(workers)
+        ]
+        self._error = None
+        for t in self._threads:
+            t.start()
+
+    def _produce(self):
+        while not self._stop.is_set():
+            try:
+                item = self.batch_fn()
+            except Exception as e:  # surface producer errors to the consumer
+                self._error = e
+                self._stop.set()
+                break
+            while not self._stop.is_set():
+                try:
+                    self.q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def __call__(self) -> tuple:
+        while True:
+            if self._error is not None:
+                raise self._error
+            try:
+                return self.q.get(timeout=0.5)
+            except queue.Empty:
+                if self._stop.is_set() and self._error is None:
+                    raise RuntimeError("prefetcher stopped")
+
+    def close(self):
+        self._stop.set()
+        while not self.q.empty():
+            try:
+                self.q.get_nowait()
+            except queue.Empty:
+                break

@@ -1,0 +1,4 @@
+from euler_tpu.models.graphsage import (  # noqa: F401
+    GraphSAGESupervised,
+    GraphSAGEUnsupervised,
+)

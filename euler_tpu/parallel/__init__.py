@@ -1,0 +1,11 @@
+from euler_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    data_sharding,
+    make_mesh,
+    param_shardings,
+    replicated,
+    shard_batch,
+    shard_params,
+    unbox_and_shard,
+)
