@@ -1,0 +1,121 @@
+"""Whole-graph batches for graph classification.
+
+The reference path (SURVEY.md §3.6): `sample_graph_label` →
+`get_graph_by_label` → WholeDataFlow + GraphGNNNet with graph pooling
+(tf_euler/python/dataflow/whole_dataflow.py, mp_utils/base_graph.py:24-47).
+The TPU shape discipline: G graphs per batch, each padded to `max_nodes`
+slots and `max_nodes * max_degree` edge slots, with segment ids for
+graph-level pooling.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import numpy as np
+
+from euler_tpu.dataflow.base import Block, DataFlow
+from euler_tpu.graph.store import DEFAULT_ID
+
+Array = jax.Array
+
+
+@flax.struct.dataclass
+class GraphBatch:
+    """G whole graphs flattened into one padded node/edge table."""
+
+    feats: Array  # f32[G*Nmax, F]
+    node_mask: Array  # bool[G*Nmax]
+    block: Block  # intra-batch edges (src/dst index the node table)
+    graph_ids: Array  # int32[G*Nmax] segment id per node slot
+    labels: Array  # f32[G, L] (one-hot / multi-hot)
+    hop_ids: Array | None = None  # int32[G*Nmax]
+    n_graphs: int = flax.struct.field(pytree_node=False, default=0)
+
+
+class WholeGraphDataFlow(DataFlow):
+    """Builds GraphBatch for a list of graph labels."""
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        max_nodes: int = 32,
+        max_degree: int = 8,
+        edge_types=None,
+        label_to_onehot: bool = True,
+        rng=None,
+    ):
+        super().__init__(graph, feature_names, rng=rng)
+        self.max_nodes = max_nodes
+        self.max_degree = max_degree
+        self.edge_types = edge_types
+        self.num_labels = len(graph.meta.graph_labels)
+        self.label_to_onehot = label_to_onehot
+
+    def query(self, label_ids: np.ndarray) -> GraphBatch:
+        label_ids = np.asarray(label_ids, dtype=np.int64)
+        g = len(label_ids)
+        nmax = self.max_nodes
+        node_tab = np.full((g, nmax), DEFAULT_ID, dtype=np.uint64)
+        groups = self.graph.get_graph_by_label(label_ids)
+        for i, nodes in enumerate(groups):
+            nodes = nodes[:nmax]
+            node_tab[i, : len(nodes)] = nodes
+        flat = node_tab.reshape(-1)
+        node_mask = flat != DEFAULT_ID
+
+        # intra-graph edges: neighbors restricted to this graph's node set
+        nbr, w, _, mask, _ = self.graph.get_full_neighbor(
+            flat, self.edge_types, max_degree=self.max_degree
+        )
+        d = nbr.shape[1]
+        # map neighbor ids → slot in this graph's row of the node table
+        gi = np.repeat(np.arange(g), nmax)  # graph of each src slot
+        slot = np.full((g * nmax, d), -1, dtype=np.int64)
+        for i in range(g):
+            row_nodes = node_tab[i]
+            sel = slice(i * nmax, (i + 1) * nmax)
+            pos = np.searchsorted(row_nodes[: len(groups[i][:nmax])], nbr[sel])
+            pos = np.clip(pos, 0, nmax - 1)
+            hit = mask[sel] & (node_tab[i][pos] == nbr[sel])
+            slot[sel] = np.where(hit, pos + i * nmax, -1)
+        # aggregation at each center node: dst = the node whose neighbors we
+        # fetched, src = the neighbor's slot in the same node table
+        center = np.repeat(np.arange(g * nmax, dtype=np.int32), d)
+        nbr_slot = slot.reshape(-1)
+        edge_mask = nbr_slot >= 0
+        nbr_slot = np.where(edge_mask, nbr_slot, 0).astype(np.int32)
+        block = Block(
+            edge_src=nbr_slot,
+            edge_dst=center,
+            edge_w=np.where(edge_mask, w.reshape(-1), 0.0).astype(np.float32),
+            mask=edge_mask,
+            n_src=g * nmax,
+            n_dst=g * nmax,
+        )
+
+        labels = np.zeros((g, max(self.num_labels, 1)), dtype=np.float32)
+        if self.label_to_onehot:
+            labels[np.arange(g), np.clip(label_ids, 0, self.num_labels - 1)] = 1.0
+        return GraphBatch(
+            feats=self.node_feats(flat),
+            node_mask=node_mask,
+            block=block,
+            graph_ids=np.repeat(np.arange(g, dtype=np.int32), nmax),
+            labels=labels,
+            hop_ids=flat.astype(np.int64).astype(np.int32),
+            n_graphs=g,
+        )
+
+
+def graph_label_batches(graph, flow: WholeGraphDataFlow, batch_size: int, rng=None):
+    """Training source: sampled graph labels → whole-graph batches
+    (graph_estimator parity)."""
+    rng = rng if rng is not None else np.random.default_rng()
+
+    def fn():
+        labels = graph.sample_graph_label(batch_size, rng=rng)
+        return (flow.query(labels),)
+
+    return fn

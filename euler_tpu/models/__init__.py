@@ -1,4 +1,11 @@
+from euler_tpu.models.embedding_models import (  # noqa: F401
+    SkipGramModel,
+    deepwalk_batches,
+    line_batches,
+)
 from euler_tpu.models.graphsage import (  # noqa: F401
     GraphSAGESupervised,
     GraphSAGEUnsupervised,
 )
+from euler_tpu.models.graph_clf import GraphClassifier  # noqa: F401
+from euler_tpu.models.kg import TransX, kg_batches, kg_rank_eval  # noqa: F401

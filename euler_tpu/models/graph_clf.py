@@ -1,0 +1,57 @@
+"""Whole-graph classifiers (examples/gin, set2set, gated_graph, graphgcn
+parity): conv stack over the batched node table → graph pooling → softmax
+head with accuracy metric (mp_utils/base_graph.py:24-47)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from euler_tpu.dataflow.whole import GraphBatch
+from euler_tpu.layers import get_conv
+from euler_tpu.nn.metrics import accuracy
+from euler_tpu.nn.pooling import AttentionPool, Pooling, Set2SetPool
+
+
+class GraphClassifier(nn.Module):
+    conv: str = "gin"
+    dims: Sequence[int] = (32, 32)
+    num_classes: int = 2
+    pool: str = "mean"  # add | mean | max | attention | set2set
+    activation: str = "relu"
+
+    def setup(self):
+        cls = get_conv(self.conv)
+        self.convs = [cls(out_dim=d) for d in self.dims]
+        if self.pool == "attention":
+            self.pooler = AttentionPool()
+        elif self.pool == "set2set":
+            self.pooler = Set2SetPool()
+        else:
+            self.pooler = Pooling(op=self.pool)
+        self.head = nn.Dense(self.num_classes)
+
+    def embed(self, batch: GraphBatch) -> jnp.ndarray:
+        act = getattr(nn, self.activation)
+        x = batch.feats
+        for i, conv in enumerate(self.convs):
+            x = conv(x, x, batch.block)
+            if i < len(self.convs) - 1:
+                x = act(x)
+            x = x * batch.node_mask[:, None]
+        return self.pooler(
+            x, batch.graph_ids, batch.n_graphs, mask=batch.node_mask
+        )
+
+    def __call__(self, batch: GraphBatch):
+        emb = self.embed(batch)
+        logits = self.head(emb)
+        labels = jnp.argmax(batch.labels, axis=-1)
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        )
+        preds = jnp.argmax(logits, axis=-1)
+        return emb, loss, "acc", accuracy(labels, preds)

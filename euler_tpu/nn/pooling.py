@@ -1,0 +1,70 @@
+"""Graph-level readouts (tf_euler/python/graph_pool parity):
+segment pooling (add/mean/max), attention pooling (scatter_softmax gating,
+attention_pool.py:36-51), and Set2Set (LSTM attention readout)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu.ops import gather, scatter, scatter_softmax
+
+
+class Pooling(nn.Module):
+    """Plain segment pooling over graph ids. op ∈ {add, mean, max}."""
+
+    op: str = "mean"
+
+    @nn.compact
+    def __call__(self, x, graph_ids, n_graphs: int, mask=None):
+        return scatter(self.op, x, graph_ids, n_graphs, mask=mask)
+
+
+class AttentionPool(nn.Module):
+    """Gated attention readout: softmax(gate(x)) per graph, then Σ α·proj(x)."""
+
+    dim: int = 0  # 0 → keep input dim
+
+    @nn.compact
+    def __call__(self, x, graph_ids, n_graphs: int, mask=None):
+        gate = nn.Dense(1)(x)[:, 0]
+        alpha = scatter_softmax(gate, graph_ids, n_graphs, mask=mask)
+        h = nn.Dense(self.dim)(x) if self.dim else x
+        return scatter("add", h * alpha[:, None], graph_ids, n_graphs, mask=mask)
+
+
+class Set2SetPool(nn.Module):
+    """Set2Set readout (order-invariant LSTM attention, set2set parity).
+
+    T rounds of: query ← LSTM(prev read); α = softmax(x·q); read = Σ αx;
+    output is [q ‖ read] per graph (2×dim)."""
+
+    steps: int = 3
+
+    @nn.compact
+    def __call__(self, x, graph_ids, n_graphs: int, mask=None):
+        d = x.shape[-1]
+        cell = nn.LSTMCell(features=d)
+        carry = cell.initialize_carry(
+            jax.random.PRNGKey(0), (n_graphs, d)
+        )
+        q_star = jnp.zeros((n_graphs, 2 * d), x.dtype)
+        for _ in range(self.steps):
+            carry, q = cell(carry, q_star)
+            e = jnp.sum(x * gather(q, graph_ids), axis=-1)
+            alpha = scatter_softmax(e, graph_ids, n_graphs, mask=mask)
+            read = scatter(
+                "add", x * alpha[:, None], graph_ids, n_graphs, mask=mask
+            )
+            q_star = jnp.concatenate([q, read], axis=-1)
+        return q_star
+
+
+POOLS = {
+    "add": lambda: Pooling(op="add"),
+    "mean": lambda: Pooling(op="mean"),
+    "max": lambda: Pooling(op="max"),
+    "attention": AttentionPool,
+    "set2set": Set2SetPool,
+}

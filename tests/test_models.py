@@ -1,0 +1,115 @@
+"""Model-zoo tests: KG embeddings (TransE/H/R/D, DistMult, RotatE) and
+random-walk models (DeepWalk/node2vec, LINE)."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.dataflow.walk import gen_pair
+from euler_tpu.estimator import Estimator, EstimatorConfig
+from euler_tpu.graph.store import DEFAULT_ID
+from euler_tpu.models import (
+    SkipGramModel,
+    TransX,
+    deepwalk_batches,
+    kg_batches,
+    kg_rank_eval,
+    line_batches,
+)
+from test_training import make_cluster_graph
+
+
+def test_gen_pair():
+    walks = np.asarray([[1, 2, 3], [4, 5, DEFAULT_ID]], dtype=np.uint64)
+    pairs, mask = gen_pair(walks, 1, 1)
+    assert pairs.shape == (12, 2)
+    valid = {tuple(p) for p in pairs[mask].tolist()}
+    assert (2, 1) in valid and (2, 3) in valid and (5, 4) in valid
+    # pad slot never pairs
+    assert not any(DEFAULT_ID in p for p in pairs[mask].tolist())
+
+
+@pytest.fixture(scope="module")
+def cluster_graph():
+    return make_cluster_graph()
+
+
+@pytest.mark.parametrize(
+    "variant", ["transe", "transh", "transr", "transd", "distmult", "rotate"]
+)
+def test_kg_training(cluster_graph, variant, tmp_path):
+    rng = np.random.default_rng(0)
+    model = TransX(
+        num_entities=64,
+        num_relations=2,
+        dim=16,
+        rel_dim=8 if variant in ("transr", "transd") else 0,
+        variant=variant,
+    )
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / variant),
+        total_steps=30,
+        learning_rate=0.05,
+        log_steps=10**9,
+    )
+    est = Estimator(model, kg_batches(cluster_graph, 32, num_negs=4, rng=rng), cfg)
+    hist = est.train(save=False)
+    assert np.isfinite(hist).all()
+    assert hist[-1] < hist[0], (variant, hist[0], hist[-1])
+
+
+def test_kg_rank_eval(cluster_graph, tmp_path):
+    rng = np.random.default_rng(0)
+    model = TransX(num_entities=64, num_relations=2, dim=16, variant="transe")
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "m"), total_steps=5, log_steps=10**9
+    )
+    est = Estimator(model, kg_batches(cluster_graph, 16, rng=rng), cfg)
+    est.train(save=False)
+    triples = np.asarray([[1, 0, 2], [3, 1, 4]], dtype=np.int32)
+    res = kg_rank_eval(model, est.params, triples, num_entities=64)
+    assert set(res) == {"mean_rank", "mrr", "hit@10"}
+    assert 1.0 <= res["mean_rank"] <= 64.0
+
+
+def test_deepwalk_training(cluster_graph, tmp_path):
+    rng = np.random.default_rng(0)
+    model = SkipGramModel(num_nodes=64, dim=16)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "dw"),
+        total_steps=25,
+        learning_rate=0.1,
+        log_steps=10**9,
+    )
+    est = Estimator(
+        model,
+        deepwalk_batches(
+            cluster_graph, 8, walk_len=4, window=2, num_negs=4, rng=rng
+        ),
+        cfg,
+    )
+    hist = est.train(save=False)
+    assert hist[-1] < hist[0]
+
+
+def test_node2vec_batches(cluster_graph):
+    rng = np.random.default_rng(0)
+    fn = deepwalk_batches(
+        cluster_graph, 4, walk_len=3, p=0.5, q=2.0, num_negs=2, rng=rng
+    )
+    (batch,) = fn()
+    assert batch["src"].shape == batch["pos"].shape
+    assert batch["negs"].shape == (len(batch["src"]), 2)
+
+
+def test_line_training(cluster_graph, tmp_path):
+    rng = np.random.default_rng(0)
+    model = SkipGramModel(num_nodes=64, dim=16, shared_context=True)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "line"),
+        total_steps=25,
+        learning_rate=0.1,
+        log_steps=10**9,
+    )
+    est = Estimator(model, line_batches(cluster_graph, 32, rng=rng), cfg)
+    hist = est.train(save=False)
+    assert hist[-1] < hist[0]
