@@ -49,6 +49,21 @@ def main():
     graph = random_graph(
         num_nodes=num_nodes, out_degree=out_degree, feat_dim=feat_dim, seed=0
     )
+    # round-trip through the on-disk shard format so the C++ engine serves
+    # the hot sampling path (falls back to numpy if the toolchain is absent)
+    try:
+        import os
+        import tempfile
+
+        from euler_tpu.graph import Graph
+        from euler_tpu.graph import format as tformat
+
+        d = tempfile.mkdtemp(prefix="etpu_bench_")
+        tformat.write_arrays(os.path.join(d, "part_0"), graph.shards[0].arrays)
+        graph.meta.save(d)
+        graph = Graph.load(d, native=True)
+    except Exception as e:
+        print(f"# native engine unavailable ({e}); using numpy store", file=sys.stderr)
     flow = SageDataFlow(
         graph, ["feat"], fanouts=fanouts, label_feature="label", rng=rng
     )

@@ -1,0 +1,233 @@
+"""ctypes binding for the native C++ graph engine (cpp/graph_engine.cc).
+
+Builds the shared library on demand (g++, cached next to the source) and
+exposes `NativeGraphStore`, a GraphStore drop-in whose hot queries (global
+sampling, neighbor sampling, dense features, walks) run in C++ over mmapped
+shard files; everything else falls back to the numpy store.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+from euler_tpu.graph.meta import GraphMeta
+from euler_tpu.graph.store import GraphStore
+
+_CPP_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "cpp")
+_SO_PATH = os.path.abspath(os.path.join(_CPP_DIR, "libeuler_tpu_engine.so"))
+_SRC_PATH = os.path.abspath(os.path.join(_CPP_DIR, "graph_engine.cc"))
+
+_lib = None
+
+
+def build_engine(force: bool = False) -> str:
+    """Compile the engine .so if missing or stale; returns its path."""
+    if (
+        not force
+        and os.path.exists(_SO_PATH)
+        and os.path.getmtime(_SO_PATH) >= os.path.getmtime(_SRC_PATH)
+    ):
+        return _SO_PATH
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        _SRC_PATH,
+        "-o",
+        _SO_PATH,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _SO_PATH
+
+
+def _u64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build_engine())
+    c = ctypes
+    u64p, i64p = c.POINTER(c.c_uint64), c.POINTER(c.c_int64)
+    i32p, f32p, u8p = (
+        c.POINTER(c.c_int32),
+        c.POINTER(c.c_float),
+        c.POINTER(c.c_uint8),
+    )
+    lib.etpu_load.restype = c.c_void_p
+    lib.etpu_load.argtypes = [c.c_char_p, c.c_int64, c.c_int64]
+    lib.etpu_free.argtypes = [c.c_void_p]
+    lib.etpu_num_nodes.restype = c.c_int64
+    lib.etpu_num_nodes.argtypes = [c.c_void_p]
+    lib.etpu_num_edges.restype = c.c_int64
+    lib.etpu_num_edges.argtypes = [c.c_void_p]
+    lib.etpu_lookup.argtypes = [c.c_void_p, u64p, c.c_int64, i64p]
+    lib.etpu_sample_node.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int32, c.c_uint64, u64p,
+    ]
+    lib.etpu_sample_edge.argtypes = [
+        c.c_void_p, c.c_int64, c.c_int32, c.c_uint64, u64p,
+    ]
+    lib.etpu_sample_neighbor.argtypes = [
+        c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_int64,
+        c.c_uint64, u64p, f32p, i32p, u8p, i64p,
+    ]
+    lib.etpu_get_dense.argtypes = [
+        c.c_void_p, u64p, c.c_int64, c.c_int64, c.c_int64, f32p,
+    ]
+    lib.etpu_random_walk.argtypes = [
+        c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_int64,
+        c.c_uint64, u64p,
+    ]
+    _lib = lib
+    return lib
+
+
+def engine_available() -> bool:
+    try:
+        _load_lib()
+        return True
+    except Exception:
+        return False
+
+
+class NativeGraphStore(GraphStore):
+    """GraphStore whose hot paths run in the C++ engine.
+
+    Loads the same on-disk tensor dir twice: mmapped numpy views (for the
+    cold paths and feature metadata) + the C++ store (hot queries).
+    """
+
+    def __init__(self, meta: GraphMeta, arrays, part: int, directory: str):
+        super().__init__(meta, arrays, part)
+        lib = _load_lib()
+        self._lib = lib
+        self._h = lib.etpu_load(
+            directory.encode(), meta.num_node_types, meta.num_edge_types
+        )
+        if not self._h:
+            raise RuntimeError(f"native engine failed to load {directory}")
+
+    def __del__(self):
+        if getattr(self, "_h", None) and self._lib is not None:
+            self._lib.etpu_free(self._h)
+            self._h = None
+
+    # -- hot paths -------------------------------------------------------
+
+    def _seed(self, rng) -> int:
+        if rng is None:
+            rng = np.random.default_rng()
+        return int(rng.integers(0, 2**63 - 1))
+
+    def lookup(self, ids):
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        rows = np.empty(len(ids), dtype=np.int64)
+        self._lib.etpu_lookup(
+            ctypes.c_void_p(self._h),
+            _u64p(ids),
+            len(ids),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return rows
+
+    def sample_node(self, count, node_type=-1, rng=None):
+        out = np.empty(count, dtype=np.uint64)
+        self._lib.etpu_sample_node(
+            ctypes.c_void_p(self._h),
+            count,
+            ctypes.c_int32(node_type),
+            ctypes.c_uint64(self._seed(rng)),
+            _u64p(out),
+        )
+        return out
+
+    def sample_edge(self, count, edge_type=-1, rng=None):
+        out = np.empty((count, 3), dtype=np.uint64)
+        self._lib.etpu_sample_edge(
+            ctypes.c_void_p(self._h),
+            count,
+            ctypes.c_int32(edge_type),
+            ctypes.c_uint64(self._seed(rng)),
+            _u64p(out),
+        )
+        return out
+
+    def sample_neighbor(self, ids, edge_types=None, count=10, rng=None, in_edges=False):
+        if in_edges:  # cold path
+            return super().sample_neighbor(ids, edge_types, count, rng, in_edges)
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        n = len(ids)
+        types = np.ascontiguousarray(
+            [] if edge_types is None else list(edge_types), dtype=np.int32
+        )
+        nbr = np.empty((n, count), dtype=np.uint64)
+        w = np.empty((n, count), dtype=np.float32)
+        tt = np.empty((n, count), dtype=np.int32)
+        mask = np.empty((n, count), dtype=np.uint8)
+        eidx = np.empty((n, count), dtype=np.int64)
+        self._lib.etpu_sample_neighbor(
+            ctypes.c_void_p(self._h),
+            _u64p(ids),
+            n,
+            types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(types),
+            count,
+            ctypes.c_uint64(self._seed(rng)),
+            _u64p(nbr),
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            tt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            eidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        return nbr, w, tt, mask.astype(bool), eidx
+
+    def get_dense_feature(self, ids, names):
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        cols = []
+        for nm in names:
+            spec = self.meta.feature_spec(nm, node=True)
+            out = np.empty((len(ids), spec.dim), dtype=np.float32)
+            self._lib.etpu_get_dense(
+                ctypes.c_void_p(self._h),
+                _u64p(ids),
+                len(ids),
+                spec.fid,
+                spec.dim,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            )
+            cols.append(out)
+        return (
+            np.concatenate(cols, axis=1)
+            if cols
+            else np.zeros((len(ids), 0), np.float32)
+        )
+
+    def random_walk(self, ids, edge_types=None, walk_len=3, p=1.0, q=1.0, rng=None):
+        if p != 1.0 or q != 1.0:  # node2vec bias → numpy path
+            return super().random_walk(ids, edge_types, walk_len, p, q, rng)
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        types = np.ascontiguousarray(
+            [] if edge_types is None else list(edge_types), dtype=np.int32
+        )
+        out = np.empty((len(ids), walk_len + 1), dtype=np.uint64)
+        self._lib.etpu_random_walk(
+            ctypes.c_void_p(self._h),
+            _u64p(ids),
+            len(ids),
+            types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(types),
+            walk_len,
+            ctypes.c_uint64(self._seed(rng)),
+            _u64p(out),
+        )
+        return out

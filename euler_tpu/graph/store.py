@@ -596,16 +596,34 @@ class Graph:
     # -- construction ----------------------------------------------------
 
     @classmethod
-    def load(cls, directory: str, mmap: bool = True) -> "Graph":
+    def load(
+        cls, directory: str, mmap: bool = True, native: bool | None = None
+    ) -> "Graph":
+        """native=True → C++ engine hot paths; None → auto (use if it builds)."""
         meta = GraphMeta.load(directory)
-        shards = [
-            GraphStore(
-                meta,
-                tformat.read_arrays(os.path.join(directory, f"part_{p}"), mmap),
-                part=p,
-            )
-            for p in range(meta.num_partitions)
-        ]
+        store_cls = GraphStore
+        if native is None or native:
+            try:
+                from euler_tpu.graph.native import (
+                    NativeGraphStore,
+                    engine_available,
+                )
+
+                if engine_available():
+                    store_cls = NativeGraphStore
+                elif native:
+                    raise RuntimeError("native engine unavailable")
+            except Exception:
+                if native:
+                    raise
+        shards = []
+        for p in range(meta.num_partitions):
+            part_dir = os.path.join(directory, f"part_{p}")
+            arrays = tformat.read_arrays(part_dir, mmap)
+            if store_cls is GraphStore:
+                shards.append(GraphStore(meta, arrays, part=p))
+            else:
+                shards.append(store_cls(meta, arrays, p, part_dir))
         return cls(meta, shards)
 
     @classmethod
