@@ -6,3 +6,5 @@ from euler_tpu.dataflow.whole import (  # noqa: F401
     WholeGraphDataFlow,
     graph_label_batches,
 )
+from euler_tpu.dataflow.layerwise import LayerwiseBatch, LayerwiseDataFlow  # noqa: F401
+from euler_tpu.dataflow.relation import RelationDataFlow, RelMiniBatch  # noqa: F401

@@ -1,0 +1,86 @@
+"""Layerwise (LADIES / FastGCN) dataflows.
+
+The reference bounds fanout blow-up with layerwise sampling
+(API_SAMPLE_L, sample_layer_op.cc:83; python neighbor_ops.py:359-366;
+LayerwiseDataFlow / FastDataFlow). The TPU form is even more natural: each
+layer is ONE fixed-size candidate set shared by the whole batch, and the
+inter-layer adjacency is a dense [n_l, n_{l+1}] weight matrix — aggregation
+becomes a plain matmul on the MXU instead of gather/scatter.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import numpy as np
+
+from euler_tpu.dataflow.base import DataFlow
+from euler_tpu.graph.store import DEFAULT_ID
+
+Array = jax.Array
+
+
+@flax.struct.dataclass
+class LayerwiseBatch:
+    """Dense-adjacency multi-layer batch.
+
+    feats[l]  — f32[N_l, F] features of layer l (layer 0 = roots)
+    masks[l]  — bool[N_l]
+    adjs[l]   — f32[N_l, N_{l+1}] weighted adjacency layer l ← l+1
+    """
+
+    feats: tuple
+    masks: tuple
+    adjs: tuple
+    root_idx: Array
+    labels: Array | None = None
+    hop_ids: tuple | None = None
+
+
+class LayerwiseDataFlow(DataFlow):
+    """LADIES-style: candidates sampled ∝ incident weight from the batch."""
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        edge_types=None,
+        layer_sizes=(128, 128),
+        label_feature=None,
+        label_dim=None,
+        normalize: bool = True,
+        rng=None,
+    ):
+        super().__init__(graph, feature_names, label_feature, label_dim, rng)
+        self.edge_types = edge_types
+        self.layer_sizes = list(layer_sizes)
+        self.normalize = normalize
+
+    def query(self, roots: np.ndarray) -> LayerwiseBatch:
+        roots = np.asarray(roots, dtype=np.uint64)
+        layer_ids = [roots]
+        layer_masks = [roots != DEFAULT_ID]
+        adjs = []
+        cur = roots
+        for count in self.layer_sizes:
+            layer, adj, lmask = self.graph.sample_neighbor_layerwise(
+                cur, self.edge_types, count=count, rng=self.rng
+            )
+            if self.normalize:
+                row = adj.sum(axis=1, keepdims=True)
+                adj = adj / np.maximum(row, 1e-9)
+            adjs.append(adj.astype(np.float32))
+            layer_ids.append(layer)
+            layer_masks.append(lmask)
+            cur = layer
+        feats = tuple(self.node_feats(ids) for ids in layer_ids)
+        return LayerwiseBatch(
+            feats=feats,
+            masks=tuple(layer_masks),
+            adjs=tuple(adjs),
+            root_idx=roots.astype(np.int64).astype(np.int32),
+            labels=self.labels_of(roots),
+            hop_ids=tuple(
+                ids.astype(np.int64).astype(np.int32) for ids in layer_ids
+            ),
+        )

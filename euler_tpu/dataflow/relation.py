@@ -1,0 +1,93 @@
+"""Per-relation dataflow for RGCN (RelationDataFlow parity,
+tf_euler/python/dataflow/relation_dataflow.py): each hop carries one Block
+per edge type so relation-specific transforms stay separable."""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import numpy as np
+
+from euler_tpu.dataflow.base import Block, DataFlow
+from euler_tpu.graph.store import DEFAULT_ID
+
+Array = jax.Array
+
+
+@flax.struct.dataclass
+class RelMiniBatch:
+    feats: tuple  # f32[N_i, F] per hop
+    masks: tuple  # bool[N_i]
+    rel_blocks: tuple  # per hop: tuple of Blocks, one per relation
+    root_idx: Array
+    labels: Array | None = None
+    hop_ids: tuple | None = None
+
+
+class RelationDataFlow(DataFlow):
+    """Fixed per-relation fanout at every hop."""
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        num_relations: int,
+        fanout: int = 5,
+        num_hops: int = 2,
+        label_feature=None,
+        label_dim=None,
+        rng=None,
+    ):
+        super().__init__(graph, feature_names, label_feature, label_dim, rng)
+        self.num_relations = num_relations
+        self.fanout = fanout
+        self.num_hops = num_hops
+
+    def query(self, roots: np.ndarray) -> RelMiniBatch:
+        roots = np.asarray(roots, dtype=np.uint64)
+        hop_ids = [roots]
+        hop_masks = [roots != DEFAULT_ID]
+        rel_blocks = []
+        cur = roots
+        k, nr = self.fanout, self.num_relations
+        for _ in range(self.num_hops):
+            n = len(cur)
+            # next hop holds nr * k slots per node: [n, nr, k] flattened
+            nxt = np.full((n, nr, k), DEFAULT_ID, dtype=np.uint64)
+            blocks = []
+            for r in range(nr):
+                nbr, w, _, mask, _ = self.graph.sample_neighbor(
+                    cur, [r], k, rng=self.rng
+                )
+                nxt[:, r, :] = nbr
+                # src slots for relation r sit at rows [i*nr*k + r*k + j]
+                src = (
+                    np.arange(n)[:, None] * nr * k
+                    + r * k
+                    + np.arange(k)[None, :]
+                ).reshape(-1)
+                blocks.append(
+                    Block(
+                        edge_src=src.astype(np.int32),
+                        edge_dst=np.repeat(np.arange(n, dtype=np.int32), k),
+                        edge_w=w.reshape(-1).astype(np.float32),
+                        mask=mask.reshape(-1),
+                        n_src=n * nr * k,
+                        n_dst=n,
+                    )
+                )
+            rel_blocks.append(tuple(blocks))
+            cur = nxt.reshape(-1)
+            hop_ids.append(cur)
+            hop_masks.append(cur != DEFAULT_ID)
+        feats = tuple(self.node_feats(ids) for ids in hop_ids)
+        return RelMiniBatch(
+            feats=feats,
+            masks=tuple(hop_masks),
+            rel_blocks=tuple(rel_blocks),
+            root_idx=roots.astype(np.int64).astype(np.int32),
+            labels=self.labels_of(roots),
+            hop_ids=tuple(
+                ids.astype(np.int64).astype(np.int32) for ids in hop_ids
+            ),
+        )

@@ -70,6 +70,9 @@ class Estimator:
         self.opt_state = None
         self.step = 0
         self.tx = make_optimizer(self.cfg)
+        # models may declare extra rng collections (e.g. VGAE's "reparam")
+        self._rng_names = tuple(getattr(model, "rng_collections", ()))
+        self._base_key = jax.random.PRNGKey((cfg or EstimatorConfig()).seed + 1)
         self._jit_train = None
         self._jit_eval = None
         self._jit_embed = None
@@ -90,7 +93,10 @@ class Estimator:
 
         batch = self._put(self.batch_fn())
         key = jax.random.PRNGKey(self.cfg.seed)
-        params = self.model.init(key, *batch)
+        keys = jax.random.split(key, 1 + len(self._rng_names))
+        rngs = {"params": keys[0]}
+        rngs.update(dict(zip(self._rng_names, keys[1:])))
+        params = self.model.init(rngs, *batch)
         if self.mesh is not None:
             from euler_tpu.parallel import unbox_and_shard
 
@@ -100,13 +106,21 @@ class Estimator:
         self.params = params
         self.opt_state = self.tx.init(self.params)
 
+    def _rngs(self, step: int):
+        if not self._rng_names:
+            return None
+        k = jax.random.fold_in(self._base_key, step)
+        return dict(zip(self._rng_names, jax.random.split(k, len(self._rng_names))))
+
     def _train_step(self):
         if self._jit_train is None:
 
             @jax.jit
-            def train_step(params, opt_state, *batch):
+            def train_step(params, opt_state, rngs, *batch):
                 def loss_fn(p):
-                    _, loss, _, metric = self.model.apply(p, *batch)
+                    _, loss, _, metric = self.model.apply(
+                        p, *batch, rngs=rngs
+                    )
                     return loss, metric
 
                 (loss, metric), grads = jax.value_and_grad(
@@ -132,7 +146,7 @@ class Estimator:
         for _ in range(steps):
             batch = self._put(self.batch_fn())
             self.params, self.opt_state, loss, metric = step_fn(
-                self.params, self.opt_state, *batch
+                self.params, self.opt_state, self._rngs(self.step), *batch
             )
             self.step += 1
             if log and self.step % self.cfg.log_steps == 0:
@@ -156,15 +170,17 @@ class Estimator:
         self._ensure_init()
         if self._jit_eval is None:
             self._jit_eval = jax.jit(
-                lambda p, *b: self.model.apply(p, *b)[1:4:2]
+                lambda p, rngs, *b: self.model.apply(p, *b, rngs=rngs)[1:4:2]
             )  # (loss, metric)
         name = None
         losses, metrics = [], []
         for batch in batches:
             batch = self._put(batch)
-            loss, metric = self._jit_eval(self.params, *batch)
+            loss, metric = self._jit_eval(self.params, self._rngs(0), *batch)
             if name is None:
-                name = self.model.apply(self.params, *batch)[2]
+                name = self.model.apply(
+                    self.params, *batch, rngs=self._rngs(0)
+                )[2]
             losses.append(float(loss))
             metrics.append(float(metric))
         return {

@@ -1,5 +1,10 @@
 from euler_tpu.layers.conv import (  # noqa: F401
     AGNNConv,
+    ARMAConv,
+    DNAConv,
+    GatedGraphConv,
+    GeniePathConv,
+    RelationConv,
     APPNPConv,
     Conv,
     GATConv,
@@ -22,6 +27,10 @@ CONVS = {
     "sgcn": SGCNConv,
     "tagcn": TAGConv,
     "agnn": AGNNConv,
+    "arma": ARMAConv,
+    "dna": DNAConv,
+    "gated": GatedGraphConv,
+    "geniepath": GeniePathConv,
 }
 
 

@@ -13,6 +13,7 @@ AGNNConv, DNAConv, ARMAConv, GatedGraphConv, RelationConv (rgcn).
 from __future__ import annotations
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from euler_tpu.dataflow.base import Block
@@ -186,3 +187,130 @@ class AGNNConv(Conv):
         )
         msgs = gather(x_src, block.edge_src) * alpha[:, None]
         return self.agg_add(msgs, block)
+
+
+class ARMAConv(Conv):
+    """ARMA_K filter, one GCS step per stack: σ(Â·x·W + x0·V), stacks
+    averaged (arma_conv.py)."""
+
+    stacks: int = 2
+
+    @nn.compact
+    def __call__(self, x_dst, x_src, block: Block):
+        deg_dst = degrees(block)
+        norm = jnp.power(deg_dst, -0.5)[:, None]
+        prop = (self.agg_add(self.msg(x_src, block), block) + x_dst) * norm
+        outs = []
+        for _ in range(self.stacks):
+            outs.append(
+                nn.relu(
+                    nn.Dense(self.out_dim, use_bias=False)(prop)
+                    + nn.Dense(self.out_dim)(x_dst)
+                )
+            )
+        return sum(outs) / self.stacks
+
+
+class DNAConv(Conv):
+    """Dot-product attention aggregation (dna_conv.py semantics adapted to
+    hop blocks: query = dst, keys/values = src neighbors)."""
+
+    heads: int = 1
+
+    @nn.compact
+    def __call__(self, x_dst, x_src, block: Block):
+        d = self.out_dim
+        q = nn.Dense(d, use_bias=False)(x_dst)
+        kk = nn.Dense(d, use_bias=False)(x_src)
+        v = nn.Dense(d, use_bias=False)(x_src)
+        e = jnp.sum(
+            gather(kk, block.edge_src) * gather(q, block.edge_dst), axis=-1
+        ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        alpha = scatter_softmax(e, block.edge_dst, block.n_dst, mask=block.mask)
+        msgs = gather(v, block.edge_src) * alpha[:, None]
+        return self.agg_add(msgs, block) + q
+
+
+class GatedGraphConv(Conv):
+    """GRU state update from summed messages (gated_conv.py)."""
+
+    @nn.compact
+    def __call__(self, x_dst, x_src, block: Block):
+        d = self.out_dim
+        pad = d - x_dst.shape[-1]
+        h = x_dst if pad == 0 else jnp.pad(x_dst, ((0, 0), (0, max(pad, 0))))
+        h = h[:, :d]
+        m = self.agg_add(
+            nn.Dense(d, use_bias=False)(self.msg(x_src, block)), block
+        )
+        gru = nn.GRUCell(features=d)
+        _, out = gru(h, m)
+        return out
+
+
+class RelationConv(Conv):
+    """RGCN: W_0·x_dst + Σ_r mean_r(W_r·x_src) with optional basis
+    decomposition (relation_conv.py). Call with per-relation blocks."""
+
+    num_relations: int = 1
+    num_bases: int = 0  # 0 → full per-relation weights
+
+    @nn.compact
+    def __call__(self, x_dst, x_src, rel_blocks):
+        d_in = x_src.shape[-1]
+        out = nn.Dense(self.out_dim)(x_dst)
+        if self.num_bases:
+            basis = self.param(
+                "basis",
+                nn.initializers.lecun_normal(),
+                (self.num_bases, d_in, self.out_dim),
+            )
+            coef = self.param(
+                "coef",
+                nn.initializers.normal(0.1),
+                (self.num_relations, self.num_bases),
+            )
+            weights = jnp.einsum("rb,bio->rio", coef, basis)
+        else:
+            weights = self.param(
+                "rel_w",
+                nn.initializers.lecun_normal(),
+                (self.num_relations, d_in, self.out_dim),
+            )
+        for r, block in enumerate(rel_blocks):
+            msgs = self.msg(x_src, block) @ weights[r]
+            total = self.agg_add(msgs, block)
+            cnt = scatter_add(
+                jnp.ones(block.edge_src.shape[0], jnp.float32),
+                block.edge_dst,
+                block.n_dst,
+                mask=block.mask,
+            )
+            out = out + total / jnp.maximum(cnt, 1.0)[:, None]
+        return out
+
+
+class GeniePathConv(Conv):
+    """GeniePath lazy variant: GAT-style breadth attention + LSTM depth
+    gate (geniepath parity)."""
+
+    @nn.compact
+    def __call__(self, x_dst, x_src, block: Block, carry=None):
+        d = self.out_dim
+        w = nn.Dense(d, use_bias=False)
+        h_src, h_dst = w(x_src), w(x_dst)
+        a = nn.Dense(1, use_bias=False)
+        e = nn.tanh(
+            a(gather(h_src, block.edge_src) + gather(h_dst, block.edge_dst))
+        )[:, 0]
+        alpha = scatter_softmax(e, block.edge_dst, block.n_dst, mask=block.mask)
+        breadth = self.agg_add(
+            gather(h_src, block.edge_src) * alpha[:, None], block
+        )
+        lstm = nn.LSTMCell(features=d)
+        if carry is None:
+            carry = lstm.initialize_carry(
+                jax.random.PRNGKey(0), breadth.shape
+            )
+        carry, out = lstm(carry, nn.tanh(breadth))
+        return out

@@ -9,3 +9,7 @@ from euler_tpu.models.graphsage import (  # noqa: F401
 )
 from euler_tpu.models.graph_clf import GraphClassifier  # noqa: F401
 from euler_tpu.models.kg import TransX, kg_batches, kg_rank_eval  # noqa: F401
+from euler_tpu.models.layerwise_models import LayerwiseGCN  # noqa: F401
+from euler_tpu.models.rgcn import RGCNSupervised  # noqa: F401
+from euler_tpu.models.autoencoders import DGI, GAE, dgi_batches, gae_batches  # noqa: F401
+from euler_tpu.models.scalable import ScalableGNN, ScalableTrainer  # noqa: F401

@@ -1,0 +1,129 @@
+"""Graph auto-encoders and contrastive models: GAE, VGAE, DGI
+(examples/gae, examples/dgi parity)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from euler_tpu.dataflow.base import MiniBatch
+from euler_tpu.nn.base_gnn import GNNNet
+from euler_tpu.nn.metrics import auc
+
+
+class GAE(nn.Module):
+    """GCN encoder + inner-product edge decoder.
+
+    Batch: (src_mb, dst_mb, neg_mb) — positive edges (src→dst) vs sampled
+    negative pairs (src→neg). variational=True adds the VGAE KL term.
+    """
+
+    dims: Sequence[int]
+    variational: bool = False
+    kl_weight: float = 1e-2
+
+    rng_collections = ("reparam",)  # consumed by Estimator
+
+    def setup(self):
+        self.encoder = GNNNet(conv="gcn", dims=self.dims)
+        if self.variational:
+            self.mu_head = nn.Dense(self.dims[-1])
+            self.logvar_head = nn.Dense(self.dims[-1])
+
+    def embed(self, batch: MiniBatch) -> jnp.ndarray:
+        h = self.encoder(batch)
+        return self.mu_head(h) if self.variational else h
+
+    def _encode(self, batch, rng):
+        h = self.encoder(batch)
+        if not self.variational:
+            return h, 0.0
+        mu = self.mu_head(h)
+        logvar = self.logvar_head(h)
+        std = jnp.exp(0.5 * logvar)
+        z = mu + std * jax.random.normal(rng, mu.shape)
+        kl = -0.5 * jnp.mean(
+            jnp.sum(1 + logvar - mu**2 - jnp.exp(logvar), axis=-1)
+        )
+        return z, kl
+
+    def __call__(self, src: MiniBatch, dst: MiniBatch, neg: MiniBatch):
+        rng = self.make_rng("reparam") if self.variational else None
+        k1 = k2 = k3 = None
+        if self.variational:
+            k1, k2, k3 = jax.random.split(rng, 3)
+        z_src, kl1 = self._encode(src, k1)
+        z_dst, kl2 = self._encode(dst, k2)
+        z_neg, kl3 = self._encode(neg, k3)
+        pos_logit = jnp.sum(z_src * z_dst, axis=-1)
+        neg_logit = jnp.sum(z_src * z_neg, axis=-1)
+        logits = jnp.concatenate([pos_logit, neg_logit])
+        labels = jnp.concatenate(
+            [jnp.ones_like(pos_logit), jnp.zeros_like(neg_logit)]
+        )
+        loss = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+        if self.variational:
+            loss = loss + self.kl_weight * (kl1 + kl2 + kl3) / 3.0
+        return z_src, loss, "auc", auc(labels, logits)
+
+
+class DGI(nn.Module):
+    """Deep Graph Infomax: real vs feature-shuffled batch against a global
+    readout through a bilinear discriminator (examples/dgi)."""
+
+    dims: Sequence[int]
+
+    def setup(self):
+        self.encoder = GNNNet(conv="gcn", dims=self.dims)
+        d = self.dims[-1]
+        self.bilinear = self.param(
+            "bilinear", nn.initializers.lecun_normal(), (d, d)
+        )
+
+    def embed(self, batch: MiniBatch) -> jnp.ndarray:
+        return self.encoder(batch)
+
+    def __call__(self, batch: MiniBatch, corrupt: MiniBatch):
+        h_real = self.encoder(batch)  # [B, D]
+        h_fake = self.encoder(corrupt)
+        summary = nn.sigmoid(jnp.mean(h_real, axis=0))  # [D]
+        score = lambda h: h @ self.bilinear @ summary  # noqa: E731
+        logits = jnp.concatenate([score(h_real), score(h_fake)])
+        labels = jnp.concatenate(
+            [jnp.ones(h_real.shape[0]), jnp.zeros(h_fake.shape[0])]
+        )
+        loss = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+        return h_real, loss, "auc", auc(labels, logits)
+
+
+def gae_batches(graph, flow, batch_size: int, edge_type: int = -1, rng=None):
+    """(src, dst, neg) mini-batch source over sampled edges."""
+    rng = rng if rng is not None else np.random.default_rng()
+
+    def fn():
+        e = graph.sample_edge(batch_size, edge_type, rng=rng)
+        neg = graph.sample_node(batch_size, -1, rng=rng)
+        return (flow.query(e[:, 0]), flow.query(e[:, 1]), flow.query(neg))
+
+    return fn
+
+
+def dgi_batches(graph, flow, batch_size: int, node_type: int = -1, rng=None):
+    """(real, corrupted) source: corruption shuffles features across the
+    batch (DGI's standard corruption)."""
+    rng = rng if rng is not None else np.random.default_rng()
+
+    def fn():
+        roots = graph.sample_node(batch_size, node_type, rng=rng)
+        mb = flow.query(roots)
+        perm_feats = tuple(
+            f[rng.permutation(len(f))] for f in mb.feats
+        )
+        return (mb, mb.replace(feats=perm_feats))
+
+    return fn

@@ -1,0 +1,53 @@
+"""Layerwise GCN / FastGCN models over dense per-layer adjacencies
+(examples/fastgcn, examples/adaptivegcn parity): aggregation is a dense
+[n_l, n_{l+1}] matmul per layer — pure MXU work."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from euler_tpu.dataflow.layerwise import LayerwiseBatch
+from euler_tpu.nn.metrics import micro_f1
+
+
+class LayerwiseGCN(nn.Module):
+    """h_l = act(A_l · h_{l+1} · W_l ⊕ self) from the deepest layer up."""
+
+    dims: Sequence[int]
+    label_dim: int
+    activation: str = "relu"
+
+    def setup(self):
+        self.denses = [nn.Dense(d) for d in self.dims]
+        self.self_denses = [nn.Dense(d, use_bias=False) for d in self.dims]
+        self.out = nn.Dense(self.label_dim)
+
+    def embed(self, batch: LayerwiseBatch) -> jnp.ndarray:
+        act = getattr(nn, self.activation)
+        num_layers = len(batch.adjs)
+        assert len(self.dims) == num_layers
+        xs = list(batch.feats)
+        for layer in range(num_layers):
+            dense = self.denses[layer]
+            self_dense = self.self_denses[layer]
+            last = layer == num_layers - 1
+            new_xs = []
+            for lv in range(num_layers - layer):
+                h = dense(batch.adjs[lv] @ xs[lv + 1]) + self_dense(xs[lv])
+                if not last:
+                    h = act(h)
+                h = h * batch.masks[lv][: h.shape[0], None]
+                new_xs.append(h)
+            xs = new_xs
+        return xs[0]
+
+    def __call__(self, batch: LayerwiseBatch):
+        emb = self.embed(batch)
+        logits = self.out(emb)
+        loss = optax.sigmoid_binary_cross_entropy(logits, batch.labels)
+        loss = jnp.mean(jnp.sum(loss, axis=-1))
+        return emb, loss, "f1", micro_f1(batch.labels, logits)

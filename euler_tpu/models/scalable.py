@@ -1,0 +1,164 @@
+"""Scalable (history-embedding) GCN/SAGE training
+(utils/encoders.py:294-410, 629-750 parity).
+
+Each layer keeps a host-side HistoryTable of its last activations; a train
+step touches only roots + their 1-hop neighbors, reading deeper context from
+the tables and refreshing the roots' rows with a moving average. Receptive
+field per step is 1 hop regardless of depth — the GAS-style scalability
+trick, with the PS variable store replaced by host numpy tables.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from euler_tpu.nn.history import HistoryTable
+from euler_tpu.nn.metrics import micro_f1
+
+
+class ScalableGNN(nn.Module):
+    """K stacked mean-aggregator layers over history inputs.
+
+    Batch dict: feats f32[B,F]; nbr_hist tuple of f32[B,k,D_l] (layer l's
+    neighbor activations from history; l=0 uses raw neighbor features);
+    nbr_mask bool[B,k]; labels f32[B,L].
+    """
+
+    dims: Sequence[int]
+    label_dim: int
+
+    def setup(self):
+        self.layers = [nn.Dense(d) for d in self.dims]
+        self.self_layers = [nn.Dense(d, use_bias=False) for d in self.dims]
+        self.out = nn.Dense(self.label_dim)
+
+    def activations(self, batch) -> list[jnp.ndarray]:
+        h = batch["feats"]
+        m = batch["nbr_mask"].astype(jnp.float32)[..., None]
+        acts = []
+        for i, (lin, self_lin) in enumerate(
+            zip(self.layers, self.self_layers)
+        ):
+            nbr = batch["nbr_hist"][i]
+            agg = jnp.sum(nbr * m, axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+            h = lin(agg) + self_lin(h)
+            if i < len(self.layers) - 1:
+                h = nn.relu(h)
+            acts.append(h)
+        return acts
+
+    def embed(self, batch) -> jnp.ndarray:
+        return self.activations(batch)[-1]
+
+    def __call__(self, batch):
+        acts = self.activations(batch)
+        logits = self.out(acts[-1])
+        loss = optax.sigmoid_binary_cross_entropy(logits, batch["labels"])
+        loss = jnp.mean(jnp.sum(loss, axis=-1))
+        return acts, loss, "f1", micro_f1(batch["labels"], logits)
+
+
+class ScalableTrainer:
+    """1-hop train loop with history fetch/update around a jitted step."""
+
+    def __init__(
+        self,
+        graph,
+        model: ScalableGNN,
+        feature_names,
+        max_id: int,
+        batch_size: int = 64,
+        fanout: int = 10,
+        edge_types=None,
+        label_feature: str = "label",
+        learning_rate: float = 0.01,
+        momentum: float = 0.9,
+        rng=None,
+    ):
+        self.graph = graph
+        self.model = model
+        self.feature_names = feature_names
+        self.batch_size = batch_size
+        self.fanout = fanout
+        self.edge_types = edge_types
+        self.label_feature = label_feature
+        self.rng = rng if rng is not None else np.random.default_rng()
+        feat_dim = graph.get_dense_feature(
+            np.asarray([1], np.uint64), feature_names
+        ).shape[1]
+        self.feat_dim = feat_dim
+        self.histories = [
+            HistoryTable(max_id, d, momentum)
+            for d in [feat_dim] + list(model.dims[:-1])
+        ]
+        self.tx = optax.adam(learning_rate)
+        self.params = None
+        self.opt_state = None
+        self._step = None
+
+    def _make_batch(self):
+        g = self.graph
+        roots = g.sample_node(self.batch_size, -1, rng=self.rng)
+        nbr, _, _, mask, _ = g.sample_neighbor(
+            roots, self.edge_types, self.fanout, rng=self.rng
+        )
+        flat = nbr.reshape(-1)
+        k = self.fanout
+        nbr_hist = []
+        for li, h in enumerate(self.histories):
+            if li == 0:
+                vals = g.get_dense_feature(flat, self.feature_names)
+            else:
+                vals = h.fetch(flat)
+            nbr_hist.append(
+                vals.reshape(self.batch_size, k, -1).astype(np.float32)
+            )
+        return roots, {
+            "feats": g.get_dense_feature(roots, self.feature_names),
+            "nbr_hist": tuple(nbr_hist),
+            "nbr_mask": mask,
+            "labels": g.get_dense_feature(roots, [self.label_feature]),
+        }
+
+    def train(self, steps: int):
+        history = []
+        for _ in range(steps):
+            roots, batch = self._make_batch()
+            if self.params is None:
+                self.params = self.model.init(jax.random.PRNGKey(0), batch)
+                self.opt_state = self.tx.init(self.params)
+
+                @jax.jit
+                def step(params, opt_state, batch):
+                    def loss_fn(p):
+                        acts, loss, _, metric = self.model.apply(p, batch)
+                        return loss, (acts, metric)
+
+                    (loss, (acts, metric)), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params)
+                    updates, opt_state = self.tx.update(
+                        grads, opt_state, params
+                    )
+                    return (
+                        optax.apply_updates(params, updates),
+                        opt_state,
+                        loss,
+                        acts,
+                    )
+
+                self._step = step
+            self.params, self.opt_state, loss, acts = self._step(
+                self.params, self.opt_state, batch
+            )
+            # refresh histories: layer l+1's input table holds layer l output
+            for li in range(1, len(self.histories)):
+                self.histories[li].update(roots, np.asarray(acts[li - 1]))
+            history.append(float(loss))
+        return history

@@ -1,0 +1,31 @@
+"""Host-side history embedding table for scalable (1-hop) training.
+
+The reference's ScalableGCN/ScalableSage trick (utils/encoders.py:294-410,
+629-750): keep every node's last-known activation in a table, train with a
+1-hop receptive field per step using stored activations for the frontier,
+and refresh the stored rows with a moving average. PS variables become a
+host numpy table (or, sharded, one slice per host); device steps stay O(1)
+in depth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class HistoryTable:
+    def __init__(self, num_nodes: int, dim: int, momentum: float = 0.9):
+        self.table = np.zeros((num_nodes + 1, dim), dtype=np.float32)
+        self.momentum = momentum
+        self.num_nodes = num_nodes
+
+    def _rows(self, ids: np.ndarray) -> np.ndarray:
+        return np.clip(ids.astype(np.int64), 0, self.num_nodes)
+
+    def fetch(self, ids: np.ndarray) -> np.ndarray:
+        return self.table[self._rows(ids)]
+
+    def update(self, ids: np.ndarray, values: np.ndarray) -> None:
+        rows = self._rows(ids)
+        m = self.momentum
+        self.table[rows] = m * self.table[rows] + (1 - m) * np.asarray(values)

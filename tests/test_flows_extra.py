@@ -1,0 +1,100 @@
+"""Layerwise and relation dataflows + their models + the extra convs."""
+
+import jax
+import numpy as np
+import pytest
+
+from euler_tpu.dataflow import (
+    LayerwiseDataFlow,
+    RelationDataFlow,
+    SageDataFlow,
+)
+from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+from euler_tpu.layers import get_conv
+from euler_tpu.models import LayerwiseGCN, RGCNSupervised
+from test_training import make_cluster_graph
+
+
+@pytest.fixture(scope="module")
+def g():
+    return make_cluster_graph()
+
+
+def test_layerwise_dataflow(g):
+    rng = np.random.default_rng(0)
+    flow = LayerwiseDataFlow(
+        g, ["feat"], layer_sizes=[8, 8], label_feature="label", rng=rng
+    )
+    mb = flow.query(g.sample_node(4, rng=rng))
+    assert mb.feats[0].shape == (4, 4)
+    assert mb.feats[1].shape == (8, 4)
+    assert mb.adjs[0].shape == (4, 8)
+    assert mb.adjs[1].shape == (8, 8)
+    # normalized rows sum to ~1 (or 0 when a node has no sampled neighbor)
+    sums = mb.adjs[0].sum(axis=1)
+    assert ((sums < 1.001) & (sums >= 0)).all()
+
+
+def test_layerwise_gcn_trains(g, tmp_path):
+    rng = np.random.default_rng(0)
+    flow = LayerwiseDataFlow(
+        g, ["feat"], layer_sizes=[8, 8], label_feature="label", rng=rng
+    )
+    model = LayerwiseGCN(dims=[16, 16], label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "lw"),
+        total_steps=30,
+        learning_rate=0.05,
+        log_steps=10**9,
+    )
+    est = Estimator(model, node_batches(g, flow, 8, rng=rng), cfg)
+    hist = est.train(save=False)
+    assert hist[-1] < hist[0] * 0.7, (hist[0], hist[-1])
+
+
+def test_relation_dataflow(g):
+    rng = np.random.default_rng(0)
+    flow = RelationDataFlow(
+        g, ["feat"], num_relations=1, fanout=3, num_hops=2,
+        label_feature="label", rng=rng,
+    )
+    mb = flow.query(g.sample_node(4, rng=rng))
+    assert len(mb.rel_blocks) == 2
+    assert len(mb.rel_blocks[0]) == 1
+    assert mb.feats[1].shape == (12, 4)
+    assert mb.rel_blocks[0][0].n_dst == 4
+
+
+def test_rgcn_trains(g, tmp_path):
+    rng = np.random.default_rng(0)
+    flow = RelationDataFlow(
+        g, ["feat"], num_relations=1, fanout=3, num_hops=2,
+        label_feature="label", rng=rng,
+    )
+    model = RGCNSupervised(
+        dims=[16, 16], num_relations=1, label_dim=2, num_bases=2
+    )
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "rgcn"),
+        total_steps=25,
+        learning_rate=0.05,
+        log_steps=10**9,
+    )
+    est = Estimator(model, node_batches(g, flow, 8, rng=rng), cfg)
+    hist = est.train(save=False)
+    assert hist[-1] < hist[0], (hist[0], hist[-1])
+
+
+@pytest.mark.parametrize("conv", ["arma", "dna", "gated", "geniepath"])
+def test_extra_convs(g, conv):
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(g, ["feat"], fanouts=[3], rng=rng)
+    mb = flow.query(np.asarray([1, 2, 3, 4], np.uint64))
+    cls = get_conv(conv)
+    layer = cls(out_dim=8)
+    params = layer.init(
+        jax.random.PRNGKey(0), mb.feats[0], mb.feats[1], mb.blocks[0]
+    )
+    out = layer.apply(params, mb.feats[0], mb.feats[1], mb.blocks[0])
+    assert out.shape == (4, 8)
+    assert np.isfinite(np.asarray(out)).all()

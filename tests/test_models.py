@@ -113,3 +113,62 @@ def test_line_training(cluster_graph, tmp_path):
     est = Estimator(model, line_batches(cluster_graph, 32, rng=rng), cfg)
     hist = est.train(save=False)
     assert hist[-1] < hist[0]
+
+
+def test_gae_vgae(cluster_graph, tmp_path):
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.models import GAE, gae_batches
+
+    for variational in (False, True):
+        rng = np.random.default_rng(0)
+        flow = SageDataFlow(cluster_graph, ["feat"], fanouts=[3], rng=rng)
+        model = GAE(dims=[16], variational=variational)
+        cfg = EstimatorConfig(
+            model_dir=str(tmp_path / f"gae{variational}"),
+            total_steps=25,
+            learning_rate=0.03,
+            log_steps=10**9,
+        )
+        est = Estimator(model, gae_batches(cluster_graph, flow, 16, rng=rng), cfg)
+        hist = est.train(save=False)
+        assert np.isfinite(hist).all()
+        assert hist[-1] < hist[0], (variational, hist[0], hist[-1])
+
+
+def test_dgi(cluster_graph, tmp_path):
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.models import DGI, dgi_batches
+
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(cluster_graph, ["feat"], fanouts=[3], rng=rng)
+    model = DGI(dims=[16])
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "dgi"),
+        total_steps=25,
+        learning_rate=0.03,
+        log_steps=10**9,
+    )
+    est = Estimator(model, dgi_batches(cluster_graph, flow, 16, rng=rng), cfg)
+    hist = est.train(save=False)
+    assert hist[-1] < hist[0]
+
+
+def test_scalable_trainer(cluster_graph):
+    from euler_tpu.models import ScalableGNN, ScalableTrainer
+
+    model = ScalableGNN(dims=[16, 16], label_dim=2)
+    trainer = ScalableTrainer(
+        cluster_graph,
+        model,
+        ["feat"],
+        max_id=64,
+        batch_size=16,
+        fanout=4,
+        learning_rate=0.05,
+        rng=np.random.default_rng(0),
+    )
+    hist = trainer.train(40)
+    assert np.isfinite(hist).all()
+    assert hist[-1] < hist[0] * 0.8, (hist[0], hist[-1])
+    # histories actually got refreshed
+    assert np.abs(trainer.histories[1].table).sum() > 0
