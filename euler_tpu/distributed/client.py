@@ -1,0 +1,278 @@
+"""Remote graph client: per-shard replica pools with failover.
+
+The reference's client stack (euler/client/): `RpcManager` keeps round-robin
+replica channels per shard with bad-host quarantine + timed revival
+(rpc_manager.h:66-124) and retries calls up to 10× (rpc_client.h:32-66).
+`RemoteShard` reproduces that contract over the wire protocol; `connect`
+assembles a standard `Graph` facade whose shards are remote, so every
+dataflow/estimator works unchanged against a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+
+from euler_tpu.distributed import wire
+from euler_tpu.distributed.registry import Registry
+from euler_tpu.graph.meta import GraphMeta
+from euler_tpu.graph.store import Graph
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def _seed(rng) -> int:
+    rng = rng if rng is not None else np.random.default_rng()
+    return int(rng.integers(0, 2**63 - 1))
+
+
+class _Replica:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.bad_until = 0.0
+        self._local = threading.local()
+
+    def _sock(self) -> socket.socket:
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            sock = socket.create_connection((self.host, self.port), timeout=30)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.sock = sock
+        return sock
+
+    def drop(self):
+        sock = getattr(self._local, "sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._local.sock = None
+
+    def call(self, op: str, values: list) -> list:
+        sock = self._sock()
+        wire.send_frame(sock, wire.encode(op, values))
+        payload = wire.read_frame(sock)
+        if payload is None:
+            raise RpcError("connection closed")
+        status, result = wire.decode(payload)
+        if status == "err":
+            raise RpcError(result[0])
+        return result
+
+
+class RemoteShard:
+    """GraphStore-compatible view of one shard served by N replicas."""
+
+    RETRIES = 10
+    QUARANTINE_S = 5.0
+
+    def __init__(self, shard: int, replicas: list[tuple[str, int]]):
+        self.shard = shard
+        self.replicas = [_Replica(h, p) for h, p in replicas]
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def add_replica(self, host: str, port: int):
+        with self._lock:
+            self.replicas.append(_Replica(host, port))
+
+    def _pick(self) -> _Replica:
+        with self._lock:
+            now = time.time()
+            for _ in range(len(self.replicas)):
+                r = self.replicas[self._rr % len(self.replicas)]
+                self._rr += 1
+                if r.bad_until <= now:
+                    return r
+            # all quarantined: take the least-recently-failed (timed revival)
+            return min(self.replicas, key=lambda r: r.bad_until)
+
+    def call(self, op: str, values: list) -> list:
+        err: Exception | None = None
+        for _ in range(self.RETRIES):
+            r = self._pick()
+            try:
+                return r.call(op, values)
+            except RpcError as e:
+                # server-side error: deterministic, don't failover-retry
+                raise
+            except (OSError, ConnectionError, ValueError) as e:
+                err = e
+                r.drop()
+                r.bad_until = time.time() + self.QUARANTINE_S
+        raise RpcError(
+            f"shard {self.shard}: all retries failed: {err}"
+        )
+
+    # -- GraphStore surface ---------------------------------------------
+
+    def lookup(self, ids):
+        return self.call("lookup", [np.asarray(ids, np.uint64)])[0]
+
+    def node_type(self, ids):
+        return self.call("node_type", [np.asarray(ids, np.uint64)])[0]
+
+    def sample_node(self, count, node_type=-1, rng=None):
+        return self.call("sample_node", [count, node_type, _seed(rng)])[0]
+
+    def sample_edge(self, count, edge_type=-1, rng=None):
+        return self.call("sample_edge", [count, edge_type, _seed(rng)])[0]
+
+    def sample_neighbor(self, ids, edge_types=None, count=10, rng=None, in_edges=False):
+        out = self.call(
+            "sample_neighbor",
+            [
+                np.asarray(ids, np.uint64),
+                _types(edge_types),
+                count,
+                _seed(rng),
+                in_edges,
+            ],
+        )
+        return _bool_mask(out, 3)
+
+    def get_full_neighbor(
+        self, ids, edge_types=None, max_degree=None, in_edges=False, sort_by=None
+    ):
+        out = self.call(
+            "get_full_neighbor",
+            [
+                np.asarray(ids, np.uint64),
+                _types(edge_types),
+                max_degree,
+                in_edges,
+                sort_by,
+            ],
+        )
+        return _bool_mask(out, 3)
+
+    def get_top_k_neighbor(self, ids, edge_types=None, k=10, in_edges=False):
+        out = self.call(
+            "get_top_k_neighbor",
+            [np.asarray(ids, np.uint64), _types(edge_types), k, in_edges],
+        )
+        return _bool_mask(out, 3)
+
+    def degree_sum(self, ids, edge_types=None, in_edges=False):
+        return self.call(
+            "degree_sum",
+            [np.asarray(ids, np.uint64), _types(edge_types), in_edges],
+        )[0]
+
+    def sample_neighbor_layerwise(self, batch_ids, edge_types=None, count=128, rng=None):
+        out = self.call(
+            "sample_neighbor_layerwise",
+            [
+                np.asarray(batch_ids, np.uint64),
+                _types(edge_types),
+                count,
+                _seed(rng),
+            ],
+        )
+        return _bool_mask(out, 2)
+
+    def get_dense_feature(self, ids, names):
+        return self.call(
+            "get_dense_feature", [np.asarray(ids, np.uint64), list(names)]
+        )[0]
+
+    def get_sparse_feature(self, ids, names, max_len=None):
+        flat = self.call(
+            "get_sparse_feature",
+            [np.asarray(ids, np.uint64), list(names), max_len],
+        )
+        return [
+            (flat[2 * i], flat[2 * i + 1].astype(bool))
+            for i in range(len(names))
+        ]
+
+    def get_binary_feature(self, ids, names):
+        flat = self.call(
+            "get_binary_feature", [np.asarray(ids, np.uint64), list(names)]
+        )
+        out = []
+        for i in range(len(names)):
+            offs, blob = flat[2 * i], flat[2 * i + 1].tobytes()
+            out.append(
+                [blob[offs[j] : offs[j + 1]] for j in range(len(offs) - 1)]
+            )
+        return out
+
+    def get_edge_dense_feature(self, edge_ids, names):
+        return self.call(
+            "get_edge_dense_feature",
+            [np.asarray(edge_ids, np.uint64), list(names)],
+        )[0]
+
+    def get_graph_by_label(self, label_ids):
+        return self.call(
+            "get_graph_by_label", [np.asarray(label_ids, np.int64)]
+        )[0]
+
+    def random_walk(self, ids, edge_types=None, walk_len=3, p=1.0, q=1.0, rng=None):
+        return self.call(
+            "random_walk",
+            [
+                np.asarray(ids, np.uint64),
+                _types(edge_types),
+                walk_len,
+                p,
+                q,
+                _seed(rng),
+            ],
+        )[0]
+
+    def _node2vec_step(self, cur, prev, edge_types, p, q, rng):
+        return self.call(
+            "node2vec_step",
+            [
+                np.asarray(cur, np.uint64),
+                np.asarray(prev, np.uint64),
+                _types(edge_types),
+                p,
+                q,
+                _seed(rng),
+            ],
+        )[0]
+
+
+def _types(edge_types):
+    return None if edge_types is None else [int(t) for t in edge_types]
+
+
+def _bool_mask(out: list, idx: int):
+    out = list(out)
+    out[idx] = out[idx].astype(bool)
+    return tuple(out)
+
+
+def connect(
+    registry_path: str | None = None,
+    cluster: dict[int, list[tuple[str, int]]] | None = None,
+    num_shards: int | None = None,
+    timeout: float = 30.0,
+) -> Graph:
+    """Build a Graph whose shards are remote.
+
+    Either `cluster` (static {shard: [(host, port), ...]}) or
+    `registry_path` (+ num_shards) must be given — the static-topology and
+    ZK-monitor modes of the reference client (query_proxy.cc:60-144).
+    """
+    if cluster is None:
+        if registry_path is None or num_shards is None:
+            raise ValueError("need cluster= or (registry_path=, num_shards=)")
+        cluster = Registry(registry_path).wait_for(num_shards, timeout)
+    shards = [
+        RemoteShard(s, cluster[s]) for s in sorted(cluster)
+    ]
+    meta_json = shards[0].call("get_meta", [])[0]
+    meta = GraphMeta.from_dict(json.loads(meta_json))
+    return Graph(meta, shards)

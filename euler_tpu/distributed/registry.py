@@ -1,0 +1,93 @@
+"""Shared-filesystem membership registry — the ZooKeeper replacement.
+
+The reference registers graph servers as ephemeral ZK znodes
+`<path>/<shard>#<host:port>` with shard metadata and re-registers on session
+loss (euler/common/zk_server_register.cc:96-161); clients watch children and
+get add/remove callbacks (server_monitor.h:33-40). TPU-VM pods share a
+filesystem (NFS/GCS-fuse) far more often than they run ZK, so membership
+here is heartbeat files in a directory: servers rewrite
+`shard_<i>@<host>_<port>.json` every interval; entries whose heartbeat is
+stale are treated as removed. Static cluster specs bypass the registry
+entirely.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class Registry:
+    def __init__(self, path: str, ttl: float = 10.0):
+        self.path = path
+        self.ttl = ttl
+        os.makedirs(path, exist_ok=True)
+
+    def _entry_path(self, shard: int, host: str, port: int) -> str:
+        return os.path.join(self.path, f"shard_{shard}@{host}_{port}.json")
+
+    # -- server side -----------------------------------------------------
+
+    def register(self, shard: int, host: str, port: int, meta: dict | None = None):
+        """Write a heartbeat entry now; returns a stop() handle that keeps
+        re-registering in the background (ZK session keep-alive parity)."""
+        stop = threading.Event()
+
+        def beat():
+            while not stop.is_set():
+                entry = {
+                    "shard": shard,
+                    "host": host,
+                    "port": port,
+                    "ts": time.time(),
+                    "meta": meta or {},
+                }
+                tmp = self._entry_path(shard, host, port) + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(entry, f)
+                os.replace(tmp, self._entry_path(shard, host, port))
+                stop.wait(self.ttl / 3)
+            try:
+                os.remove(self._entry_path(shard, host, port))
+            except OSError:
+                pass
+
+        t = threading.Thread(target=beat, daemon=True)
+        t.start()
+        return stop
+
+    # -- client side -----------------------------------------------------
+
+    def lookup(self, num_shards: int) -> dict[int, list[tuple[str, int]]]:
+        """shard → [(host, port), ...] with live heartbeats."""
+        now = time.time()
+        out: dict[int, list[tuple[str, int]]] = {
+            s: [] for s in range(num_shards)
+        }
+        for name in sorted(os.listdir(self.path)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.path, name)) as f:
+                    e = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if now - e.get("ts", 0) > self.ttl:
+                continue
+            s = int(e["shard"])
+            if s in out:
+                out[s].append((e["host"], int(e["port"])))
+        return out
+
+    def wait_for(self, num_shards: int, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            table = self.lookup(num_shards)
+            if all(table[s] for s in range(num_shards)):
+                return table
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"registry at {self.path}: not all {num_shards} shards present"
+        )

@@ -1,0 +1,217 @@
+"""Graph shard server — the reference's GrpcServer/GrpcWorker role
+(euler/service/grpc_server.h:38-80, grpc_worker.cc:40-96): load one shard,
+serve batch queries over threaded TCP, heartbeat into the registry.
+
+Start programmatically (`GraphService(...).start()`) or as a process:
+    python -m euler_tpu.distributed.service --data DIR --shard 0 \
+        --num-shards 2 --port 9190 --registry /path/reg
+(euler.start() parity, euler/python/start_service.py:70-80).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from euler_tpu.distributed import wire
+from euler_tpu.distributed.registry import Registry
+from euler_tpu.graph import format as tformat
+from euler_tpu.graph.meta import GraphMeta
+from euler_tpu.graph.store import GraphStore
+
+
+def _rng_from(seed) -> np.random.Generator:
+    return np.random.default_rng(seed if seed is not None else None)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        service: GraphService = self.server.service  # type: ignore[attr-defined]
+        sock: socket.socket = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                payload = wire.read_frame(sock)
+            except (ConnectionError, OSError):
+                return
+            if payload is None:
+                return
+            op, args = wire.decode(payload)
+            try:
+                result = service.dispatch(op, args)
+                frame = wire.encode("ok", result)
+            except Exception as e:  # report, keep serving
+                frame = wire.encode("err", [f"{type(e).__name__}: {e}"])
+            try:
+                wire.send_frame(sock, frame)
+            except (ConnectionError, OSError):
+                return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class GraphService:
+    """Serves one GraphStore shard over the wire protocol."""
+
+    def __init__(
+        self,
+        store: GraphStore,
+        meta: GraphMeta,
+        shard: int,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        registry: Registry | None = None,
+    ):
+        self.store = store
+        self.meta = meta
+        self.shard = shard
+        self.server = _Server((host, port), _Handler)
+        self.server.service = self  # type: ignore[attr-defined]
+        self.host, self.port = self.server.server_address
+        self.registry = registry
+        self._beat = None
+        self._thread = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        if self.registry is not None:
+            self._beat = self.registry.register(
+                self.shard, self.host, self.port
+            )
+        return self
+
+    def stop(self):
+        if self._beat is not None:
+            self._beat.set()
+        self.server.shutdown()
+        self.server.server_close()
+
+    # -- dispatch --------------------------------------------------------
+
+    def dispatch(self, op: str, a: list) -> list:
+        s = self.store
+        if op == "get_meta":
+            return [json.dumps(self.meta.to_dict())]
+        if op == "ping":
+            return [self.shard]
+        if op == "lookup":
+            return [s.lookup(a[0])]
+        if op == "node_type":
+            return [s.node_type(a[0])]
+        if op == "sample_node":
+            return [s.sample_node(a[0], a[1], _rng_from(a[2]))]
+        if op == "sample_edge":
+            return [s.sample_edge(a[0], a[1], _rng_from(a[2]))]
+        if op == "sample_neighbor":
+            out = s.sample_neighbor(a[0], a[1], a[2], _rng_from(a[3]), a[4])
+            return list(out)
+        if op == "get_full_neighbor":
+            out = s.get_full_neighbor(a[0], a[1], a[2], a[3], a[4])
+            return list(out)
+        if op == "get_top_k_neighbor":
+            return list(s.get_top_k_neighbor(a[0], a[1], a[2], a[3]))
+        if op == "degree_sum":
+            return [s.degree_sum(a[0], a[1], a[2])]
+        if op == "sample_neighbor_layerwise":
+            return list(
+                s.sample_neighbor_layerwise(a[0], a[1], a[2], _rng_from(a[3]))
+            )
+        if op == "get_dense_feature":
+            return [s.get_dense_feature(a[0], a[1])]
+        if op == "get_sparse_feature":
+            pairs = s.get_sparse_feature(a[0], a[1], a[2])
+            return [x for pair in pairs for x in pair]
+        if op == "get_binary_feature":
+            outs = s.get_binary_feature(a[0], a[1])
+            # bytes → u8 arrays with per-name offsets
+            result = []
+            for vals in outs:
+                blob = b"".join(vals)
+                offs = np.cumsum([0] + [len(v) for v in vals]).astype(np.int64)
+                result.append(offs)
+                result.append(np.frombuffer(blob, dtype=np.uint8))
+            return result
+        if op == "get_edge_dense_feature":
+            return [s.get_edge_dense_feature(a[0], a[1])]
+        if op == "get_graph_by_label":
+            return [list(s.get_graph_by_label(a[0]))]
+        if op == "random_walk":
+            return [s.random_walk(a[0], a[1], a[2], a[3], a[4], _rng_from(a[5]))]
+        if op == "node2vec_step":
+            return [
+                s._node2vec_step(a[0], a[1], a[2], a[3], a[4], _rng_from(a[5]))
+            ]
+        raise ValueError(f"unknown op {op!r}")
+
+
+def serve_shard(
+    data_dir: str,
+    shard: int,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    registry_path: str | None = None,
+    native: bool | None = None,
+) -> GraphService:
+    """Load shard `shard` of the dataset at data_dir and serve it."""
+    meta = GraphMeta.load(data_dir)
+    part_dir = os.path.join(data_dir, f"part_{shard}")
+    arrays = tformat.read_arrays(part_dir)
+    store: GraphStore
+    if native is None or native:
+        try:
+            from euler_tpu.graph.native import NativeGraphStore, engine_available
+
+            if engine_available():
+                store = NativeGraphStore(meta, arrays, shard, part_dir)
+            else:
+                raise RuntimeError("engine unavailable")
+        except Exception:
+            if native:
+                raise
+            store = GraphStore(meta, arrays, shard)
+    else:
+        store = GraphStore(meta, arrays, shard)
+    registry = Registry(registry_path) if registry_path else None
+    return GraphService(store, meta, shard, host, port, registry).start()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--shard", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--registry", default=None)
+    ap.add_argument("--no-native", action="store_true")
+    args = ap.parse_args(argv)
+    svc = serve_shard(
+        args.data,
+        args.shard,
+        args.host,
+        args.port,
+        args.registry,
+        native=False if args.no_native else None,
+    )
+    print(f"serving shard {args.shard} on {svc.host}:{svc.port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Wire protocol for the graph service: length-prefixed binary frames.
+
+Replaces the reference's TensorProto-over-gRPC encoding
+(euler/core/framework/tensor_util.h, proto/worker.proto:137-152) with a
+minimal self-describing format — no proto toolchain needed, arrays travel as
+raw little-endian buffers, and the C++ engine could emit the same frames.
+
+Frame:   [u32 payload_len][payload]
+Payload: [u16 op_len][op utf8][u16 n_values][value...]
+Value:   [u8 tag] + tag-specific body
+  0 array: [u8 dtype_code][u8 ndim][i64 shape...]["raw bytes"]
+  1 int:   [i64]
+  2 float: [f64]
+  3 str:   [u32 len][utf8]
+  4 none:  —
+  5 bool:  [u8]
+  6 list of values: [u16 n][value...]
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import numpy as np
+
+from euler_tpu.graph.format import _CODE_DTYPES, _DTYPE_CODES
+
+MAX_FRAME = 1 << 31
+
+
+def _pack_value(buf: bytearray, v) -> None:
+    if isinstance(v, np.ndarray):
+        v = np.ascontiguousarray(v)
+        if v.dtype == np.bool_:
+            v = v.astype(np.uint8)
+        buf += struct.pack("<BBB", 0, _DTYPE_CODES[v.dtype], v.ndim)
+        for d in v.shape:
+            buf += struct.pack("<q", d)
+        buf += v.tobytes()
+    elif isinstance(v, bool):
+        buf += struct.pack("<BB", 5, int(v))
+    elif isinstance(v, (int, np.integer)):
+        buf += struct.pack("<Bq", 1, int(v))
+    elif isinstance(v, (float, np.floating)):
+        buf += struct.pack("<Bd", 2, float(v))
+    elif isinstance(v, str):
+        raw = v.encode()
+        buf += struct.pack("<BI", 3, len(raw))
+        buf += raw
+    elif v is None:
+        buf += struct.pack("<B", 4)
+    elif isinstance(v, (list, tuple)):
+        buf += struct.pack("<BH", 6, len(v))
+        for item in v:
+            _pack_value(buf, item)
+    else:
+        raise TypeError(f"cannot encode {type(v)}")
+
+
+def _unpack_value(view: memoryview, off: int):
+    (tag,) = struct.unpack_from("<B", view, off)
+    off += 1
+    if tag == 0:
+        code, ndim = struct.unpack_from("<BB", view, off)
+        off += 2
+        shape = []
+        for _ in range(ndim):
+            (d,) = struct.unpack_from("<q", view, off)
+            off += 8
+            shape.append(d)
+        dt = _CODE_DTYPES[code]
+        n = int(np.prod(shape)) if shape else 1
+        nbytes = dt.itemsize * n
+        arr = (
+            np.frombuffer(view[off : off + nbytes], dtype=dt)
+            .reshape(shape)
+            .copy()
+        )
+        return arr, off + nbytes
+    if tag == 1:
+        (v,) = struct.unpack_from("<q", view, off)
+        return int(v), off + 8
+    if tag == 2:
+        (v,) = struct.unpack_from("<d", view, off)
+        return float(v), off + 8
+    if tag == 3:
+        (n,) = struct.unpack_from("<I", view, off)
+        off += 4
+        return bytes(view[off : off + n]).decode(), off + n
+    if tag == 4:
+        return None, off
+    if tag == 5:
+        (v,) = struct.unpack_from("<B", view, off)
+        return bool(v), off + 1
+    if tag == 6:
+        (n,) = struct.unpack_from("<H", view, off)
+        off += 2
+        items = []
+        for _ in range(n):
+            item, off = _unpack_value(view, off)
+            items.append(item)
+        return items, off
+    raise ValueError(f"bad tag {tag}")
+
+
+def encode(op: str, values) -> bytes:
+    buf = bytearray()
+    raw = op.encode()
+    buf += struct.pack("<H", len(raw))
+    buf += raw
+    buf += struct.pack("<H", len(values))
+    for v in values:
+        _pack_value(buf, v)
+    return struct.pack("<I", len(buf)) + bytes(buf)
+
+
+def decode(payload: bytes) -> tuple[str, list]:
+    view = memoryview(payload)
+    (op_len,) = struct.unpack_from("<H", view, 0)
+    off = 2
+    op = bytes(view[off : off + op_len]).decode()
+    off += op_len
+    (n,) = struct.unpack_from("<H", view, off)
+    off += 2
+    values = []
+    for _ in range(n):
+        v, off = _unpack_value(view, off)
+        values.append(v)
+    return op, values
+
+
+def read_frame(sock: socket.socket) -> bytes | None:
+    header = _read_exact(sock, 4)
+    if header is None:
+        return None
+    (n,) = struct.unpack("<I", header)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return _read_exact(sock, n)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes | None:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def send_frame(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(data)

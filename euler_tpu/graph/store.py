@@ -335,6 +335,16 @@ class GraphStore:
             mask = take(mask, order, 1)
         return nbr, w, tt, mask, eidx
 
+    def degree_sum(self, ids, edge_types=None, in_edges=False) -> np.ndarray:
+        """Total degree per id across the requested edge types (0 if absent)."""
+        rows = self.lookup(ids)
+        safe = np.maximum(rows, 0)
+        total = np.zeros(len(rows), dtype=np.int64)
+        for _, c in self._csrs(edge_types, in_edges):
+            total += c.degrees(safe)
+        total[rows < 0] = 0
+        return total
+
     def get_top_k_neighbor(self, ids, edge_types=None, k=10, in_edges=False):
         nbr, w, tt, mask, eidx = self.get_full_neighbor(
             ids, edge_types, in_edges=in_edges, sort_by="weight"
@@ -741,11 +751,7 @@ class Graph:
 
     def max_degree(self, ids, edge_types=None, in_edges=False) -> int:
         degs = self._scatter_gather(
-            ids,
-            lambda sh, i: np.stack(
-                [c.degrees(np.maximum(sh.lookup(i), 0)) for _, c in sh._csrs(edge_types, in_edges)],
-                axis=1,
-            ).sum(axis=1),
+            ids, lambda sh, i: sh.degree_sum(i, edge_types, in_edges)
         )
         return max(int(np.max(degs, initial=0)), 1)
 

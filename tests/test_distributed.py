@@ -1,0 +1,136 @@
+"""Distributed graph service: wire protocol, registry membership, remote
+queries vs local parity, replica failover — the in-process analog of the
+reference's forked-server end-to-end tests (end2end_test.cc:48-100)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.distributed import Registry, connect, serve_shard
+from euler_tpu.distributed import wire
+from euler_tpu.distributed.client import RemoteShard, RpcError
+from euler_tpu.graph import Graph, convert_json
+
+ALL_IDS = np.arange(1, 7, dtype=np.uint64)
+
+
+def test_wire_roundtrip():
+    values = [
+        np.arange(6, dtype=np.uint64).reshape(2, 3),
+        np.ones(3, dtype=np.float32),
+        7,
+        2.5,
+        "hello",
+        None,
+        True,
+        [1, "x", np.zeros(2, dtype=np.int32)],
+    ]
+    op, back = wire.decode(wire.encode("test_op", values)[4:])
+    assert op == "test_op"
+    np.testing.assert_array_equal(back[0], values[0])
+    np.testing.assert_array_equal(back[1], values[1])
+    assert back[2:7] == [7, 2.5, "hello", None, True]
+    assert back[7][0] == 1 and back[7][1] == "x"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory, fixture_graph_dict):
+    d = tmp_path_factory.mktemp("dist")
+    data = str(d / "data")
+    convert_json(fixture_graph_dict, data, num_partitions=2)
+    reg = str(d / "registry")
+    services = [
+        serve_shard(data, 0, registry_path=reg, native=False),
+        serve_shard(data, 1, registry_path=reg, native=False),
+    ]
+    local = Graph.load(data, native=False)
+    remote = connect(registry_path=reg, num_shards=2)
+    yield remote, local, services, data, reg
+    for s in services:
+        s.stop()
+
+
+def test_registry_membership(cluster):
+    _, _, services, _, reg = cluster
+    table = Registry(reg).lookup(2)
+    assert len(table[0]) == 1 and len(table[1]) == 1
+    assert table[0][0][1] == services[0].port
+
+
+def test_remote_matches_local(cluster, rng):
+    remote, local, *_ = cluster
+    np.testing.assert_array_equal(
+        remote.node_type(ALL_IDS), local.node_type(ALL_IDS)
+    )
+    np.testing.assert_allclose(
+        remote.get_dense_feature(ALL_IDS, ["dense2", "dense3"]),
+        local.get_dense_feature(ALL_IDS, ["dense2", "dense3"]),
+    )
+    rn, rw, rt, rm, _ = remote.get_full_neighbor(ALL_IDS)
+    ln, lw, lt, lm, _ = local.get_full_neighbor(ALL_IDS)
+    for i in range(6):
+        assert set(rn[i][rm[i]].tolist()) == set(ln[i][lm[i]].tolist())
+    [(rv, rmk)] = remote.get_sparse_feature(ALL_IDS, ["sp"])
+    [(lv, lmk)] = local.get_sparse_feature(ALL_IDS, ["sp"])
+    np.testing.assert_array_equal(rv[rmk], lv[lmk])
+    [rb] = remote.get_binary_feature(ALL_IDS[:2], ["blob"])
+    assert rb == [b"1a", b"2a"]
+
+
+def test_remote_sampling(cluster, rng):
+    remote, *_ = cluster
+    ids = remote.sample_node(500, rng=rng)
+    assert set(np.unique(ids)) <= set(ALL_IDS.tolist())
+    nbr, w, tt, mask, _ = remote.sample_neighbor(ALL_IDS, None, 5, rng=rng)
+    assert mask.all()
+    walks = remote.random_walk(ALL_IDS, walk_len=3, rng=rng)
+    assert walks.shape == (6, 4)
+    walks2 = remote.random_walk(ALL_IDS, walk_len=3, p=0.5, q=2.0, rng=rng)
+    assert walks2.shape == (6, 4)
+    e = remote.sample_edge(100, edge_type=0, rng=rng)
+    assert set(e[:, 2].tolist()) == {0}
+
+
+def test_remote_dataflow_training(cluster, tmp_path):
+    """A full training loop against the remote cluster."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.nn import SuperviseModel
+
+    remote, *_ = cluster
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        remote, ["dense2"], fanouts=[2], label_feature="dense3", rng=rng
+    )
+    model = SuperviseModel(conv="sage", dims=[8], label_dim=3)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "rm"), total_steps=4, log_steps=10**9
+    )
+    est = Estimator(model, node_batches(remote, flow, 4, rng=rng), cfg)
+    hist = est.train(save=False)
+    assert np.isfinite(hist).all()
+
+
+def test_failover(cluster, tmp_path_factory):
+    """Two replicas of one shard; killing one must not break queries."""
+    _, _, _, data, _ = cluster
+    s_a = serve_shard(data, 0, native=False)
+    s_b = serve_shard(data, 0, native=False)
+    shard = RemoteShard(0, [("127.0.0.1", s_a.port), ("127.0.0.1", s_b.port)])
+    shard.RETRIES = 5
+    ids = np.asarray([2, 4, 6], np.uint64)
+    assert shard.node_type(ids).tolist() == [0, 0, 0]
+    s_a.stop()
+    # repeated calls must all succeed via the surviving replica
+    for _ in range(6):
+        assert shard.node_type(ids).tolist() == [0, 0, 0]
+    s_b.stop()
+
+
+def test_server_error_reporting(cluster):
+    remote, *_ = cluster
+    with pytest.raises(RpcError, match="unknown"):
+        remote.shards[0].call("no_such_op", [])
+    with pytest.raises(RpcError, match="KeyError"):
+        remote.shards[0].get_dense_feature(ALL_IDS, ["nope"])
