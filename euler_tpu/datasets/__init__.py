@@ -1,0 +1,10 @@
+from euler_tpu.datasets.base import Dataset, cache_dir  # noqa: F401
+from euler_tpu.datasets.catalog import (  # noqa: F401
+    DATASETS,
+    KGDataset,
+    PlanetoidDataset,
+    SageDataset,
+    TUDataset,
+    get_dataset,
+)
+from euler_tpu.datasets.synthetic import random_graph  # noqa: F401

@@ -1,0 +1,347 @@
+"""Dataset catalog (tf_euler/python/dataset/* parity): cora, citeseer,
+pubmed (Planetoid), ppi, reddit (GraphSAGE json/npy), mutag (TU graph
+classification), fb15k / fb15k237 / wn18 (KG triples)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from euler_tpu.datasets.base import Dataset, _planted_partition_json
+
+
+class PlanetoidDataset(Dataset):
+    """cora / citeseer / pubmed from the classic Planetoid pickles."""
+
+    sizes = {
+        "cora": (2708, 1433, 7),
+        "citeseer": (3327, 3703, 6),
+        "pubmed": (19717, 500, 3),
+    }
+
+    def __init__(self, name: str, **kw):
+        self.name = name
+        n, f, c = self.sizes[name]
+        self.num_nodes, self.feature_dim, self.num_classes = n, f, c
+        super().__init__(**kw)
+
+    def raw_files(self):
+        parts = ["x", "y", "tx", "ty", "allx", "ally", "graph", "test.index"]
+        return [f"ind.{self.name}.{p}" for p in parts]
+
+    def build_json(self) -> dict:
+        import pickle
+
+        def load(part):
+            path = os.path.join(self.root, f"ind.{self.name}.{part}")
+            if part == "test.index":
+                return np.loadtxt(path, dtype=np.int64)
+            with open(path, "rb") as f:
+                return pickle.load(f, encoding="latin1")
+
+        x, y, tx, ty, allx, ally = (
+            load(p) for p in ("x", "y", "tx", "ty", "allx", "ally")
+        )
+        graph = load("graph")
+        test_idx = load("test.index")
+        feats = np.vstack([np.asarray(allx.todense()), np.asarray(tx.todense())])
+        labels = np.vstack([ally, ty])
+        # standard fixup: the test block arrives permuted by test.index
+        sorted_test = np.sort(test_idx)
+        feats[test_idx] = feats[sorted_test]
+        labels[test_idx] = labels[sorted_test]
+        n = feats.shape[0]
+        train_n = len(np.asarray(y))
+        val_n = 500
+        types = np.full(n, 2)
+        types[:train_n] = 0
+        types[train_n : train_n + val_n] = 1
+        nodes = [
+            {
+                "id": i + 1,
+                "type": int(types[i]),
+                "weight": 1.0,
+                "features": [
+                    {"name": "feature", "type": "dense", "value": feats[i].tolist()},
+                    {"name": "label", "type": "dense", "value": labels[i].tolist()},
+                ],
+            }
+            for i in range(n)
+        ]
+        edges = [
+            {"src": i + 1, "dst": j + 1, "type": 0, "weight": 1.0, "features": []}
+            for i, nbrs in graph.items()
+            for j in nbrs
+            if i < n and j < n
+        ]
+        return {"nodes": nodes, "edges": edges}
+
+    def synthetic_json(self, seed: int = 0) -> dict:
+        return _planted_partition_json(
+            min(self.num_nodes, 600),
+            min(self.feature_dim, 64),
+            self.num_classes,
+            seed=seed,
+        )
+
+
+class SageDataset(Dataset):
+    """ppi / reddit in the GraphSAGE release layout
+    (<name>-G.json, -feats.npy, -class_map.json, -id_map.json)."""
+
+    sizes = {"ppi": (50, 121, True), "reddit": (602, 41, False)}
+
+    def __init__(self, name: str, **kw):
+        self.name = name
+        f, c, multi = self.sizes[name]
+        self.feature_dim, self.num_classes, self.multilabel = f, c, multi
+        super().__init__(**kw)
+
+    def raw_files(self):
+        return [
+            f"{self.name}-G.json",
+            f"{self.name}-feats.npy",
+            f"{self.name}-class_map.json",
+            f"{self.name}-id_map.json",
+        ]
+
+    def build_json(self) -> dict:
+        with open(os.path.join(self.root, f"{self.name}-G.json")) as f:
+            g = json.load(f)
+        feats = np.load(os.path.join(self.root, f"{self.name}-feats.npy"))
+        with open(os.path.join(self.root, f"{self.name}-class_map.json")) as f:
+            class_map = json.load(f)
+        with open(os.path.join(self.root, f"{self.name}-id_map.json")) as f:
+            id_map = json.load(f)
+        nodes = []
+        for nd in g["nodes"]:
+            nid = id_map[str(nd["id"])]
+            t = 1 if nd.get("val") else (2 if nd.get("test") else 0)
+            y = class_map[str(nd["id"])]
+            label = (
+                np.asarray(y, dtype=np.float32)
+                if isinstance(y, list)
+                else np.eye(self.num_classes, dtype=np.float32)[int(y)]
+            )
+            nodes.append(
+                {
+                    "id": nid + 1,
+                    "type": t,
+                    "weight": 1.0,
+                    "features": [
+                        {"name": "feature", "type": "dense", "value": feats[nid].tolist()},
+                        {"name": "label", "type": "dense", "value": label.tolist()},
+                    ],
+                }
+            )
+        edges = [
+            {
+                "src": id_map[str(e["source"])] + 1,
+                "dst": id_map[str(e["target"])] + 1,
+                "type": 0,
+                "weight": 1.0,
+                "features": [],
+            }
+            for e in g["links"]
+        ]
+        return {"nodes": nodes, "edges": edges}
+
+    def synthetic_json(self, seed: int = 0) -> dict:
+        return _planted_partition_json(
+            400, min(self.feature_dim, 64), min(self.num_classes, 16), seed=seed
+        )
+
+
+class TUDataset(Dataset):
+    """mutag-style graph classification (TU DS_A / DS_graph_indicator /
+    DS_graph_labels / DS_node_labels files)."""
+
+    def __init__(self, name: str = "mutag", **kw):
+        self.name = name
+        self.feature_dim = 8
+        self.num_classes = 2
+        super().__init__(**kw)
+
+    def raw_files(self):
+        up = self.name.upper()
+        return [
+            f"{up}_A.txt",
+            f"{up}_graph_indicator.txt",
+            f"{up}_graph_labels.txt",
+            f"{up}_node_labels.txt",
+        ]
+
+    def build_json(self) -> dict:
+        up = self.name.upper()
+        edges_raw = np.loadtxt(
+            os.path.join(self.root, f"{up}_A.txt"), delimiter=",", dtype=np.int64
+        )
+        gi = np.loadtxt(
+            os.path.join(self.root, f"{up}_graph_indicator.txt"), dtype=np.int64
+        )
+        gl = np.loadtxt(
+            os.path.join(self.root, f"{up}_graph_labels.txt"), dtype=np.int64
+        )
+        nl = np.loadtxt(
+            os.path.join(self.root, f"{up}_node_labels.txt"), dtype=np.int64
+        )
+        num_nl = int(nl.max()) + 1
+        nodes = [
+            {
+                "id": i + 1,
+                "type": 0,
+                "weight": 1.0,
+                "features": [
+                    {
+                        "name": "feature",
+                        "type": "dense",
+                        "value": np.eye(num_nl)[nl[i]].tolist(),
+                    },
+                    {
+                        "name": "graph_label",
+                        "type": "binary",
+                        "value": f"g{gi[i]}_c{gl[gi[i] - 1]}",
+                    },
+                ],
+            }
+            for i in range(len(gi))
+        ]
+        edges = [
+            {"src": int(s), "dst": int(d), "type": 0, "weight": 1.0, "features": []}
+            for s, d in edges_raw
+        ]
+        return {"nodes": nodes, "edges": edges}
+
+    def synthetic_json(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        nodes, edges = [], []
+        nid = 1
+        for gidx in range(24):
+            cls = gidx % 2
+            size = int(rng.integers(5, 9))
+            ids = list(range(nid, nid + size))
+            nid += size
+            for i in ids:
+                nodes.append(
+                    {
+                        "id": i,
+                        "type": 0,
+                        "weight": 1.0,
+                        "features": [
+                            {
+                                "name": "feature",
+                                "type": "dense",
+                                "value": rng.normal(2.0 * (1 - 2 * cls), 1, 8).tolist(),
+                            },
+                            {
+                                "name": "graph_label",
+                                "type": "binary",
+                                "value": f"g{gidx}_c{cls}",
+                            },
+                        ],
+                    }
+                )
+            for i in ids:
+                for j in ids:
+                    if i != j and (cls == 0 or abs(i - j) <= 1):
+                        edges.append(
+                            {"src": i, "dst": j, "type": 0, "weight": 1.0, "features": []}
+                        )
+        return {"nodes": nodes, "edges": edges}
+
+
+class KGDataset(Dataset):
+    """fb15k / fb15k237 / wn18 triples (train/valid/test .txt TSV)."""
+
+    def __init__(self, name: str = "fb15k", **kw):
+        self.name = name
+        super().__init__(**kw)
+        self.entity_map: dict[str, int] = {}
+        self.relation_map: dict[str, int] = {}
+
+    def raw_files(self):
+        return ["train.txt", "valid.txt", "test.txt"]
+
+    def _triples(self, split: str):
+        path = os.path.join(self.root, f"{split}.txt")
+        out = []
+        with open(path) as f:
+            for line in f:
+                h, r, t = line.rstrip("\n").split("\t")
+                out.append((h, r, t))
+        return out
+
+    def build_json(self) -> dict:
+        train = self._triples("train")
+        ents, rels = {}, {}
+        for h, r, t in train:
+            ents.setdefault(h, len(ents) + 1)
+            ents.setdefault(t, len(ents) + 1)
+            rels.setdefault(r, len(rels))
+        self.entity_map, self.relation_map = ents, rels
+        nodes = [
+            {"id": i, "type": 0, "weight": 1.0, "features": []}
+            for i in ents.values()
+        ]
+        edges = [
+            {
+                "src": ents[h],
+                "dst": ents[t],
+                "type": rels[r],
+                "weight": 1.0,
+                "features": [],
+            }
+            for h, r, t in train
+        ]
+        return {"nodes": nodes, "edges": edges}
+
+    def eval_triples(self, split: str = "test") -> np.ndarray:
+        """int32 [M, 3] (h, r, t) restricted to known entities/relations."""
+        out = []
+        for h, r, t in self._triples(split):
+            if h in self.entity_map and t in self.entity_map and r in self.relation_map:
+                out.append(
+                    (self.entity_map[h], self.relation_map[r], self.entity_map[t])
+                )
+        return np.asarray(out, dtype=np.int32)
+
+    def synthetic_json(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        n_ent, n_rel, n_tri = 200, 6, 2000
+        nodes = [
+            {"id": i + 1, "type": 0, "weight": 1.0, "features": []}
+            for i in range(n_ent)
+        ]
+        edges = [
+            {
+                "src": int(rng.integers(1, n_ent + 1)),
+                "dst": int(rng.integers(1, n_ent + 1)),
+                "type": int(rng.integers(0, n_rel)),
+                "weight": 1.0,
+                "features": [],
+            }
+            for _ in range(n_tri)
+        ]
+        return {"nodes": nodes, "edges": edges}
+
+
+DATASETS = {
+    "cora": lambda **kw: PlanetoidDataset("cora", **kw),
+    "citeseer": lambda **kw: PlanetoidDataset("citeseer", **kw),
+    "pubmed": lambda **kw: PlanetoidDataset("pubmed", **kw),
+    "ppi": lambda **kw: SageDataset("ppi", **kw),
+    "reddit": lambda **kw: SageDataset("reddit", **kw),
+    "mutag": lambda **kw: TUDataset("mutag", **kw),
+    "fb15k": lambda **kw: KGDataset("fb15k", **kw),
+    "fb15k237": lambda **kw: KGDataset("fb15k237", **kw),
+    "wn18": lambda **kw: KGDataset("wn18", **kw),
+}
+
+
+def get_dataset(name: str, **kw) -> Dataset:
+    """Factory (tf_euler/python/dataset get_dataset parity)."""
+    if name not in DATASETS:
+        raise KeyError(f"unknown dataset {name!r}; have {sorted(DATASETS)}")
+    return DATASETS[name](**kw)

@@ -1,0 +1,280 @@
+"""Unified model-zoo runner — the `examples/run_<model>.py` scripts of the
+reference (e.g. examples/gcn/run_gcn.py:46-84) folded into one CLI.
+
+    python -m euler_tpu.examples.run_model --model gcn --dataset cora \
+        --mode train --total-steps 200
+    python -m euler_tpu.examples.run_model --model transe --dataset fb15k
+    python -m euler_tpu.examples.run_model --model deepwalk --dataset cora
+
+Model families (27-model zoo parity):
+  conv supervised:   gcn sage gat agnn appnp arma sgcn tagcn dna gated
+                     geniepath graph (examples/<name>)
+  conv unsupervised: graphsage_unsup dgi gae vgae
+  layerwise:         fastgcn adaptivegcn
+  relation:          rgcn
+  graph clf:         gin set2set gated_graph graphgcn
+  embeddings:        deepwalk node2vec line
+  knowledge graph:   transe transh transr transd distmult rotate
+  scalable:          scalable_gcn scalable_sage
+
+--synthetic uses each dataset's offline stand-in (this environment has no
+network egress); with raw files in $EULER_TPU_DATA the real datasets load.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+CONV_MODELS = {
+    "gcn": "gcn",
+    "graphsage": "sage",
+    "sage": "sage",
+    "gat": "gat",
+    "agnn": "agnn",
+    "appnp": "appnp",
+    "arma": "arma",
+    "sgcn": "sgcn",
+    "tagcn": "tagcn",
+    "dna": "dna",
+    "gated": "gated",
+    "geniepath": "geniepath",
+    "graph": "graph",
+    "lgcn": "gat",
+    "adaptivegcn": None,  # layerwise family
+}
+GRAPH_CLF = {"gin": ("gin", "mean"), "set2set": ("gin", "set2set"),
+             "gated_graph": ("gated", "mean"), "graphgcn": ("gcn", "attention")}
+KG_MODELS = {"transe", "transh", "transr", "transd", "distmult", "rotate"}
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--dataset", default="cora")
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--synthetic", action="store_true")
+    ap.add_argument("--mode", default="train",
+                    choices=["train", "evaluate", "infer", "train_and_evaluate"])
+    ap.add_argument("--model-dir", default="/tmp/euler_tpu_runs")
+    ap.add_argument("--hidden-dim", type=int, default=32)
+    ap.add_argument("--embedding-dim", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--fanouts", type=int, nargs="*", default=[10, 10])
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--total-steps", type=int, default=100)
+    ap.add_argument("--learning-rate", type=float, default=0.01)
+    ap.add_argument("--optimizer", default="adam")
+    ap.add_argument("--num-negs", type=int, default=5)
+    ap.add_argument("--walk-len", type=int, default=5)
+    ap.add_argument("--window", type=int, default=2)
+    ap.add_argument("--p", type=float, default=1.0)
+    ap.add_argument("--q", type=float, default=1.0)
+    ap.add_argument("--log-steps", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--data-parallel", type=int, default=0,
+                    help="devices for a data-parallel mesh (0 = single)")
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    from euler_tpu.datasets import get_dataset
+    from euler_tpu.estimator import Estimator, EstimatorConfig, id_batches, node_batches
+    from euler_tpu.graph import Graph
+
+    rng = np.random.default_rng(args.seed)
+    ds = get_dataset(args.dataset) if args.data_dir is None else None
+    graph = (
+        Graph.load(args.data_dir)
+        if args.data_dir
+        else ds.load_graph(synthetic=args.synthetic)
+    )
+    max_id = int(
+        max(int(np.asarray(sh.node_ids).max(initial=0)) for sh in graph.shards)
+    )
+
+    cfg = EstimatorConfig(
+        model_dir=f"{args.model_dir}/{args.model}_{args.dataset}",
+        batch_size=args.batch_size,
+        total_steps=args.total_steps,
+        learning_rate=args.learning_rate,
+        optimizer=args.optimizer,
+        log_steps=args.log_steps,
+        seed=args.seed,
+    )
+    mesh = None
+    if args.data_parallel:
+        from euler_tpu.parallel import make_mesh
+
+        mesh = make_mesh(args.data_parallel)
+
+    name = args.model
+    feature = "feature"
+    label_dim = getattr(ds, "num_classes", 2) if ds else 2
+    dims = [args.hidden_dim] * args.layers
+
+    # ---- family dispatch -------------------------------------------------
+    if name in KG_MODELS:
+        from euler_tpu.models import TransX, kg_batches
+
+        model = TransX(
+            num_entities=max_id,
+            num_relations=graph.meta.num_edge_types,
+            dim=args.embedding_dim,
+            variant=name,
+        )
+        est = Estimator(
+            model, kg_batches(graph, args.batch_size, args.num_negs, rng=rng),
+            cfg, mesh=mesh,
+        )
+    elif name in ("deepwalk", "node2vec", "line"):
+        from euler_tpu.models import SkipGramModel, deepwalk_batches, line_batches
+
+        model = SkipGramModel(
+            num_nodes=max_id, dim=args.embedding_dim,
+            shared_context=(name == "line"),
+        )
+        bf = (
+            line_batches(graph, args.batch_size, args.num_negs, rng=rng)
+            if name == "line"
+            else deepwalk_batches(
+                graph, args.batch_size, args.walk_len, args.window,
+                args.num_negs, p=args.p if name == "node2vec" else 1.0,
+                q=args.q if name == "node2vec" else 1.0, rng=rng,
+            )
+        )
+        est = Estimator(model, bf, cfg, mesh=mesh)
+    elif name in GRAPH_CLF:
+        from euler_tpu.dataflow import WholeGraphDataFlow, graph_label_batches
+        from euler_tpu.models import GraphClassifier
+
+        conv, pool = GRAPH_CLF[name]
+        flow = WholeGraphDataFlow(graph, [feature], max_nodes=16, max_degree=8, rng=rng)
+        model = GraphClassifier(
+            conv=conv, dims=tuple(dims),
+            num_classes=max(len(graph.meta.graph_labels), 2), pool=pool,
+        )
+        est = Estimator(
+            model, graph_label_batches(graph, flow, args.batch_size, rng=rng),
+            cfg, mesh=mesh,
+        )
+    elif name in ("fastgcn", "adaptivegcn"):
+        from euler_tpu.dataflow import LayerwiseDataFlow
+        from euler_tpu.models import LayerwiseGCN
+
+        flow = LayerwiseDataFlow(
+            graph, [feature], layer_sizes=[64] * args.layers,
+            label_feature="label", rng=rng,
+        )
+        model = LayerwiseGCN(dims=dims, label_dim=label_dim)
+        est = Estimator(
+            model, node_batches(graph, flow, args.batch_size, 0, rng=rng),
+            cfg, mesh=mesh,
+        )
+    elif name == "rgcn":
+        from euler_tpu.dataflow import RelationDataFlow
+        from euler_tpu.models import RGCNSupervised
+
+        flow = RelationDataFlow(
+            graph, [feature], num_relations=graph.meta.num_edge_types,
+            fanout=args.fanouts[0], num_hops=args.layers,
+            label_feature="label", rng=rng,
+        )
+        model = RGCNSupervised(
+            dims=dims, num_relations=graph.meta.num_edge_types,
+            label_dim=label_dim, num_bases=4,
+        )
+        est = Estimator(
+            model, node_batches(graph, flow, args.batch_size, 0, rng=rng),
+            cfg, mesh=mesh,
+        )
+    elif name in ("gae", "vgae"):
+        from euler_tpu.dataflow import SageDataFlow
+        from euler_tpu.models import GAE, gae_batches
+
+        flow = SageDataFlow(graph, [feature], fanouts=args.fanouts[:1], rng=rng)
+        model = GAE(dims=dims[:1], variational=(name == "vgae"))
+        est = Estimator(
+            model, gae_batches(graph, flow, args.batch_size, rng=rng), cfg,
+            mesh=mesh,
+        )
+    elif name == "dgi":
+        from euler_tpu.dataflow import SageDataFlow
+        from euler_tpu.models import DGI, dgi_batches
+
+        flow = SageDataFlow(graph, [feature], fanouts=args.fanouts[:1], rng=rng)
+        model = DGI(dims=dims[:1])
+        est = Estimator(
+            model, dgi_batches(graph, flow, args.batch_size, rng=rng), cfg,
+            mesh=mesh,
+        )
+    elif name in ("scalable_gcn", "scalable_sage"):
+        from euler_tpu.models import ScalableGNN, ScalableTrainer
+
+        model = ScalableGNN(dims=dims, label_dim=label_dim)
+        trainer = ScalableTrainer(
+            graph, model, [feature], max_id=max_id,
+            batch_size=args.batch_size, fanout=args.fanouts[0],
+            learning_rate=args.learning_rate, rng=rng,
+        )
+        hist = trainer.train(args.total_steps)
+        print(f"final loss: {hist[-1]:.4f}")
+        return 0
+    elif name == "graphsage_unsup":
+        from euler_tpu.dataflow import SageDataFlow
+        from euler_tpu.estimator import unsupervised_batches
+        from euler_tpu.models import GraphSAGEUnsupervised
+
+        flow = SageDataFlow(graph, [feature], fanouts=args.fanouts[: args.layers], rng=rng)
+        model = GraphSAGEUnsupervised(dims=dims)
+        est = Estimator(
+            model,
+            unsupervised_batches(
+                graph, flow, args.batch_size, num_negs=args.num_negs, rng=rng
+            ),
+            cfg, mesh=mesh,
+        )
+    elif name in CONV_MODELS and CONV_MODELS[name]:
+        from euler_tpu.dataflow import SageDataFlow
+        from euler_tpu.nn import SuperviseModel
+
+        flow = SageDataFlow(
+            graph, [feature], fanouts=args.fanouts[: args.layers],
+            label_feature="label", rng=rng,
+        )
+        model = SuperviseModel(
+            conv=CONV_MODELS[name], dims=dims, label_dim=label_dim
+        )
+        est = Estimator(
+            model, node_batches(graph, flow, args.batch_size, 0, rng=rng),
+            cfg, mesh=mesh,
+        )
+    else:
+        raise SystemExit(f"unknown model {name!r}")
+
+    # ---- drive ----------------------------------------------------------
+    if args.mode == "train":
+        est.train()
+    elif args.mode == "train_and_evaluate":
+        splits = ds.splits(graph) if ds else {"val": graph.sample_node(64)}
+        batches_fn = lambda: id_batches(flow, splits["val"], args.batch_size)[0]  # noqa: E731
+        print(est.train_and_evaluate(batches_fn, eval_every=max(args.total_steps // 2, 1)))
+    elif args.mode == "evaluate":
+        est.restore()
+        splits = ds.splits(graph) if ds else {"test": graph.sample_node(64)}
+        batches, _ = id_batches(flow, splits["test"], args.batch_size)
+        print(est.evaluate(batches))
+    elif args.mode == "infer":
+        est.restore()
+        splits = ds.splits(graph) if ds else {"test": graph.sample_node(64)}
+        ids = np.concatenate(list(splits.values()))
+        batches, chunks = id_batches(flow, ids, args.batch_size)
+        idv, emb = est.infer(batches, chunks)
+        print(f"wrote {emb.shape} embeddings to {cfg.model_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+from euler_tpu.query.gql import Query, run_gql  # noqa: F401

@@ -1,0 +1,206 @@
+"""GQL — gremlin-like graph query chains compiled to batch ops.
+
+The reference compiles GQL strings through flex/bison → DAG → optimizer →
+kernels (euler/parser/gremlin.l:15-56, gremlin.y, compiler.h:35-196). Every
+tf_euler kernel actually emits a fixed template like
+`v(nodes).sampleNB(et0,et1,n).as(nb)` (sample_fanout_op.cc:36-49), so the
+TPU build compiles the same surface straight to the vectorized batch API —
+the scatter/REMOTE/merge machinery already lives in the Graph facade.
+
+Supported steps (token names follow gremlin.l):
+  sources:  v(ids|param) | e(param) | sampleN(type, n) | sampleE(type, n)
+  traverse: sampleNB(t..., n) | sampleLNB(t..., n) | outV(t...) | inV(t...)
+  fetch:    values(f...) | label() | get()
+  filter:   has_type(t) | limit(n) | order_by(id|weight[, desc])
+  name:     as(alias)
+
+`Query(gql).run(graph, inputs)` returns {alias: result}. Neighbor aliases
+map to (ids, weights, types, mask); values aliases to feature arrays.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from euler_tpu.graph.store import DEFAULT_ID
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<name>[A-Za-z_][A-Za-z0-9_]*)|(?P<num>-?\d+(?:\.\d+)?)"
+    r"|(?P<str>'[^']*'|\"[^\"]*\")|(?P<punct>[().,\[\]]))"
+)
+
+
+def _tokenize(src: str):
+    pos = 0
+    out = []
+    while pos < len(src):
+        m = _TOKEN.match(src, pos)
+        if m is None:
+            raise SyntaxError(f"bad GQL at …{src[pos:pos + 20]!r}")
+        pos = m.end()
+        if m.group("name") is not None:
+            out.append(("name", m.group("name")))
+        elif m.group("num") is not None:
+            n = m.group("num")
+            out.append(("num", float(n) if "." in n else int(n)))
+        elif m.group("str") is not None:
+            out.append(("str", m.group("str")[1:-1]))
+        else:
+            out.append(("punct", m.group("punct")))
+    return out
+
+
+def _parse(src: str) -> list[tuple[str, list]]:
+    """'.'-chained calls → [(fn_name, args), ...]."""
+    toks = _tokenize(src)
+    i = 0
+    calls = []
+
+    def expect(kind, val=None):
+        nonlocal i
+        if i >= len(toks) or toks[i][0] != kind or (
+            val is not None and toks[i][1] != val
+        ):
+            got = toks[i] if i < len(toks) else ("eof", "")
+            raise SyntaxError(f"expected {val or kind}, got {got[1]!r}")
+        i += 1
+        return toks[i - 1][1]
+
+    try:
+        while i < len(toks):
+            fn = expect("name")
+            args = []
+            expect("punct", "(")
+            while toks[i] != ("punct", ")"):
+                kind, val = toks[i]
+                if kind in ("num", "str", "name"):
+                    args.append(val)
+                    i += 1
+                elif (kind, val) == ("punct", "["):
+                    i += 1
+                    lst = []
+                    while toks[i] != ("punct", "]"):
+                        if toks[i][0] in ("num", "str"):
+                            lst.append(toks[i][1])
+                        i += 1
+                    i += 1
+                    args.append(lst)
+                else:
+                    i += 1
+                    continue
+                if i < len(toks) and toks[i] == ("punct", ","):
+                    i += 1
+            expect("punct", ")")
+            calls.append((fn, args))
+            if i < len(toks):
+                expect("punct", ".")
+    except IndexError:
+        raise SyntaxError("unexpected end of GQL input") from None
+    return calls
+
+
+class Query:
+    """Compiled GQL chain; compile once, run per batch (Compiler cache
+    parity, compiler.h:112-126)."""
+
+    def __init__(self, gql: str):
+        self.gql = gql
+        self.calls = _parse(gql)
+        if not self.calls:
+            raise SyntaxError("empty query")
+
+    def run(self, graph, inputs: dict | None = None, rng=None) -> dict:
+        inputs = inputs or {}
+        rng = rng if rng is not None else np.random.default_rng()
+        cur: np.ndarray | None = None  # current node frontier (u64)
+        last: object = None  # last step's full result
+        results: dict[str, object] = {}
+
+        def resolve_ids(arg):
+            if isinstance(arg, str):
+                return np.asarray(inputs[arg], dtype=np.uint64)
+            if isinstance(arg, list):
+                return np.asarray(arg, dtype=np.uint64)
+            return np.asarray([arg], dtype=np.uint64)
+
+        for fn, args in self.calls:
+            if fn == "v":
+                cur = resolve_ids(args[0])
+                last = cur
+            elif fn == "e":
+                edges = np.asarray(inputs[args[0]], dtype=np.uint64)
+                cur = edges[:, 1]  # frontier = dst
+                last = edges
+            elif fn == "sampleN":
+                t, n = int(args[0]), int(args[1])
+                cur = graph.sample_node(n, t, rng=rng)
+                last = cur
+            elif fn == "sampleE":
+                t, n = int(args[0]), int(args[1])
+                last = graph.sample_edge(n, t, rng=rng)
+                cur = last[:, 1]
+            elif fn in ("sampleNB", "outV", "inV", "sampleLNB"):
+                *types, n = args if fn in ("sampleNB", "sampleLNB") else (
+                    list(args) + [0]
+                )
+                et = [int(t) for t in types] if types else None
+                if fn == "sampleNB":
+                    nbr, w, tt, mask, _ = graph.sample_neighbor(
+                        cur, et, int(n), rng=rng
+                    )
+                    last = (nbr, w, tt, mask)
+                    cur = nbr.reshape(-1)
+                elif fn == "sampleLNB":
+                    layer, adj, lmask = graph.sample_neighbor_layerwise(
+                        cur, et, int(n), rng=rng
+                    )
+                    last = (layer, adj, lmask)
+                    cur = layer
+                else:
+                    nbr, w, tt, mask, _ = graph.get_full_neighbor(
+                        cur, et, in_edges=(fn == "inV")
+                    )
+                    last = (nbr, w, tt, mask)
+                    cur = nbr.reshape(-1)
+            elif fn == "values":
+                last = graph.get_dense_feature(cur, [str(a) for a in args])
+            elif fn == "label":
+                last = graph.node_type(cur)
+            elif fn == "get":
+                last = cur
+            elif fn == "has_type":
+                keep = graph.node_type(cur) == int(args[0])
+                cur = np.where(keep, cur, DEFAULT_ID)
+                last = cur
+            elif fn == "limit":
+                n = int(args[0])
+                cur = cur[:n]
+                if isinstance(last, np.ndarray):
+                    last = last[:n]
+            elif fn == "order_by":
+                if not (isinstance(last, tuple) and len(last) == 4):
+                    raise ValueError("order_by follows a neighbor step")
+                nbr, w, tt, mask = last
+                key = w if args[0] == "weight" else nbr
+                desc = len(args) > 1 and str(args[1]).lower() == "desc"
+                order = np.argsort(-key if desc else key, axis=1, kind="stable")
+                take = np.take_along_axis
+                last = (
+                    take(nbr, order, 1),
+                    take(w, order, 1),
+                    take(tt, order, 1),
+                    take(mask, order, 1),
+                )
+                cur = last[0].reshape(-1)
+            elif fn == "as":
+                results[str(args[0])] = last
+            else:
+                raise ValueError(f"unknown GQL step {fn!r}")
+        results.setdefault("_", last)
+        return results
+
+
+def run_gql(graph, gql: str, inputs=None, rng=None) -> dict:
+    return Query(gql).run(graph, inputs, rng=rng)

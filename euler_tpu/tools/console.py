@@ -1,0 +1,70 @@
+"""Interactive GQL console (euler/tools/remote_console parity).
+
+Connects to a local graph dir or a running cluster and evaluates GQL
+chains, e.g.:
+
+    > v([1,2]).sampleNB(0, 1, 3).as(nb)
+    > sampleN(0, 5).values(f3).as(feats)
+
+Usage:
+    python -m euler_tpu.tools.console --data DIR
+    python -m euler_tpu.tools.console --registry REG --num-shards N
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from euler_tpu.query import run_gql
+
+
+def _print_result(name, value):
+    if isinstance(value, tuple):
+        for i, part in enumerate(value):
+            print(f"{name}[{i}]:\n{np.asarray(part)}")
+    else:
+        print(f"{name}:\n{np.asarray(value)}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", default=None, help="local graph directory")
+    ap.add_argument("--registry", default=None)
+    ap.add_argument("--num-shards", type=int, default=None)
+    args = ap.parse_args(argv)
+    if args.data:
+        from euler_tpu.graph import Graph
+
+        graph = Graph.load(args.data)
+    elif args.registry:
+        from euler_tpu.distributed import connect
+
+        graph = connect(
+            registry_path=args.registry, num_shards=args.num_shards
+        )
+    else:
+        ap.error("need --data or --registry")
+    print("euler_tpu console — GQL chains; 'quit' to exit")
+    while True:
+        try:
+            line = input("> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not line:
+            continue
+        if line in ("quit", "exit"):
+            break
+        try:
+            results = run_gql(graph, line)
+        except Exception as e:
+            print(f"error: {e}")
+            continue
+        for name, value in results.items():
+            _print_result(name, value)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,98 @@
+"""GQL query-chain tests on the fixture graph (both shard counts)."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.query import Query, run_gql
+
+
+@pytest.fixture(params=["graph1", "graph2"])
+def g(request):
+    return request.getfixturevalue(request.param)
+
+
+def test_v_values(g):
+    res = run_gql(g, "v([1, 2]).values(dense2).as(f)")
+    np.testing.assert_allclose(res["f"], [[1.1, 1.2], [2.1, 2.2]], rtol=1e-6)
+
+
+def test_v_param_input(g):
+    res = run_gql(
+        g,
+        "v(nodes).label().as(t)",
+        inputs={"nodes": np.asarray([1, 2, 999], np.uint64)},
+    )
+    assert res["t"].tolist() == [1, 0, -1]
+
+
+def test_sample_nb_chain(g, rng):
+    res = run_gql(g, "v([1, 2, 3]).sampleNB(0, 1, 4).as(nb)", rng=rng)
+    nbr, w, tt, mask = res["nb"]
+    assert nbr.shape == (3, 4)
+    assert mask.all()
+
+
+def test_sample_n(g, rng):
+    res = run_gql(g, "sampleN(0, 50).as(n)", rng=rng)
+    assert set(np.unique(res["n"])) <= {2, 4, 6}
+
+
+def test_sample_e_chain(g, rng):
+    res = run_gql(g, "sampleE(1, 20).values(dense2).as(f)", rng=rng)
+    assert res["f"].shape == (20, 2)
+
+
+def test_outv_order_limit(g):
+    res = run_gql(
+        g, "v([1]).outV(0, 1).order_by(weight, desc).as(nb)"
+    )
+    nbr, w, tt, mask = res["nb"]
+    valid_w = w[0][mask[0]]
+    assert list(valid_w) == sorted(valid_w, reverse=True)
+
+
+def test_inv(g):
+    res = run_gql(g, "v([1]).inV().as(nb)")
+    nbr, _, _, mask = res["nb"]
+    assert set(nbr[0][mask[0]].tolist()) == {3, 5, 6}
+
+
+def test_has_type_filter(g):
+    res = run_gql(g, "v([1, 2, 3, 4]).has_type(0).get().as(kept)")
+    kept = res["kept"]
+    assert kept[1] == 2 and kept[3] == 4
+    assert kept[0] == np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def test_multi_hop_fanout_template(g, rng):
+    """The exact template shape sample_fanout compiles to."""
+    res = run_gql(
+        g,
+        "v(roots).sampleNB(0, 1, 2).as(nb_0).sampleNB(0, 1, 3).as(nb_1)",
+        inputs={"roots": np.asarray([1, 2], np.uint64)},
+        rng=rng,
+    )
+    assert res["nb_0"][0].shape == (2, 2)
+    assert res["nb_1"][0].shape == (4, 3)
+
+
+def test_layerwise_step(g, rng):
+    res = run_gql(g, "v([1, 2, 3]).sampleLNB(0, 1, 4).as(layer)", rng=rng)
+    layer, adj, mask = res["layer"]
+    assert layer.shape == (4,) and adj.shape == (3, 4)
+
+
+def test_syntax_errors():
+    with pytest.raises(SyntaxError):
+        Query("v([1).as(x)")
+    with pytest.raises(SyntaxError):
+        Query("")
+    with pytest.raises(ValueError):
+        Query("bogus_step(1)").run(None)
+
+
+def test_query_reuse(g, rng):
+    q = Query("v(roots).sampleNB(0, 1, 2).as(nb)")
+    for ids in ([1, 2], [3, 4, 5]):
+        res = q.run(g, {"roots": np.asarray(ids, np.uint64)}, rng=rng)
+        assert res["nb"][0].shape == (len(ids), 2)
