@@ -1,0 +1,1 @@
+from euler_tpu.contrib.spmm import spmm_aggregate  # noqa: F401

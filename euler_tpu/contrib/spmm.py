@@ -1,0 +1,24 @@
+"""SpMM-based aggregation alternative (tf_euler/python/contrib/spmm.py
+parity): aggregate neighbor features with a sparse adjacency × dense
+feature product via jax.experimental.sparse BCOO — useful when the batch
+graph is given as COO instead of padded grids."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+
+def spmm_aggregate(
+    edge_src, edge_dst, edge_w, x, n_dst: int, mask=None
+) -> jnp.ndarray:
+    """out[d] = Σ_{edges (s→d)} w · x[s] as one BCOO matmul."""
+    w = jnp.asarray(edge_w, x.dtype)
+    if mask is not None:
+        w = jnp.where(mask, w, 0)
+    indices = jnp.stack(
+        [jnp.asarray(edge_dst, jnp.int32), jnp.asarray(edge_src, jnp.int32)],
+        axis=1,
+    )
+    adj = jsparse.BCOO((w, indices), shape=(n_dst, x.shape[0]))
+    return adj @ x

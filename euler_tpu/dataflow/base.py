@@ -30,6 +30,9 @@ class Block:
     mask: Array  # bool[E] valid-edge mask
     n_src: int = flax.struct.field(pytree_node=False)
     n_dst: int = flax.struct.field(pytree_node=False)
+    # >0 when edges are grid-structured (dst row i owns slots [i*g, (i+1)*g));
+    # unlocks the fused Pallas gather+reduce path
+    grid: int = flax.struct.field(pytree_node=False, default=0)
 
 
 @flax.struct.dataclass
@@ -98,4 +101,5 @@ def fanout_block(batch: int, fanout: int, w: np.ndarray, mask: np.ndarray) -> Bl
         mask=mask.reshape(-1),
         n_src=e,
         n_dst=batch,
+        grid=fanout,
     )

@@ -93,6 +93,7 @@ class WholeGraphDataFlow(DataFlow):
             mask=edge_mask,
             n_src=g * nmax,
             n_dst=g * nmax,
+            grid=d,
         )
 
         labels = np.zeros((g, max(self.num_labels, 1)), dtype=np.float32)

@@ -46,10 +46,23 @@ class PlanetoidDataset(Dataset):
         )
         graph = load("graph")
         test_idx = load("test.index")
-        feats = np.vstack([np.asarray(allx.todense()), np.asarray(tx.todense())])
-        labels = np.vstack([ally, ty])
-        # standard fixup: the test block arrives permuted by test.index
+        tx_dense = np.asarray(tx.todense())
+        ty_dense = np.asarray(ty)
         sorted_test = np.sort(test_idx)
+        lo, hi = int(test_idx.min()), int(test_idx.max())
+        if hi - lo + 1 > len(test_idx):
+            # citeseer: test.index has gaps (isolated nodes) — extend the
+            # test block over the full contiguous range, zero-filling
+            tx_ext = np.zeros((hi - lo + 1, tx_dense.shape[1]))
+            ty_ext = np.zeros((hi - lo + 1, ty_dense.shape[1]))
+            tx_ext[sorted_test - lo] = tx_dense
+            ty_ext[sorted_test - lo] = ty_dense
+            tx_dense, ty_dense = tx_ext, ty_ext
+            sorted_test = np.arange(lo, hi + 1)
+            test_idx = sorted_test
+        feats = np.vstack([np.asarray(allx.todense()), tx_dense])
+        labels = np.vstack([np.asarray(ally), ty_dense])
+        # standard fixup: the test block arrives permuted by test.index
         feats[test_idx] = feats[sorted_test]
         labels[test_idx] = labels[sorted_test]
         n = feats.shape[0]
@@ -281,6 +294,8 @@ class KGDataset(Dataset):
             ents.setdefault(t, len(ents) + 1)
             rels.setdefault(r, len(rels))
         self.entity_map, self.relation_map = ents, rels
+        with open(os.path.join(self.root, "id_maps.json"), "w") as f:
+            json.dump({"entities": ents, "relations": rels}, f)
         nodes = [
             {"id": i, "type": 0, "weight": 1.0, "features": []}
             for i in ents.values()
@@ -299,6 +314,18 @@ class KGDataset(Dataset):
 
     def eval_triples(self, split: str = "test") -> np.ndarray:
         """int32 [M, 3] (h, r, t) restricted to known entities/relations."""
+        if not self.entity_map:
+            # maps persist across runs (build_json only runs on conversion)
+            maps_path = os.path.join(self.root, "id_maps.json")
+            if not os.path.exists(maps_path):
+                raise FileNotFoundError(
+                    f"{maps_path} missing — load_graph(synthetic=False) must "
+                    "have built the real dataset before eval_triples"
+                )
+            with open(maps_path) as f:
+                maps = json.load(f)
+            self.entity_map = maps["entities"]
+            self.relation_map = maps["relations"]
         out = []
         for h, r, t in self._triples(split):
             if h in self.entity_map and t in self.entity_map and r in self.relation_map:

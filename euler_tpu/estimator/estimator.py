@@ -33,6 +33,12 @@ class EstimatorConfig:
     log_steps: int = 20
     checkpoint_steps: int = 0  # 0 = only at end
     seed: int = 0
+    # profiling (BaseEstimator(profiling=True) parity, base_estimator.py:
+    # 130-133): when set, a jax.profiler trace of `profile_steps` steps is
+    # written there once, starting at `profile_start_step`
+    profile_dir: str = ""
+    profile_start_step: int = 10
+    profile_steps: int = 5
 
 
 def make_optimizer(cfg: EstimatorConfig) -> optax.GradientTransformation:
@@ -143,12 +149,27 @@ class Estimator:
         step_fn = self._train_step()
         t0 = time.time()
         history = []
+        profiling = False
         for _ in range(steps):
+            if (
+                self.cfg.profile_dir
+                and not getattr(self, "_profiled", False)
+                and self.step >= self.cfg.profile_start_step
+            ):
+                jax.profiler.start_trace(self.cfg.profile_dir)
+                profiling = True
+                self._profiled = True
             batch = self._put(self.batch_fn())
             self.params, self.opt_state, loss, metric = step_fn(
                 self.params, self.opt_state, self._rngs(self.step), *batch
             )
             self.step += 1
+            if profiling and self.step >= (
+                self.cfg.profile_start_step + self.cfg.profile_steps
+            ):
+                jax.block_until_ready(loss)
+                jax.profiler.stop_trace()
+                profiling = False
             if log and self.step % self.cfg.log_steps == 0:
                 loss_v = float(loss)
                 dt = time.time() - t0
@@ -162,6 +183,9 @@ class Estimator:
                 and self.step % self.cfg.checkpoint_steps == 0
             ):
                 self.save()
+        if profiling:  # loop ended inside the profile window
+            jax.block_until_ready(self.params)
+            jax.profiler.stop_trace()
         if save:
             self.save()
         return history

@@ -114,6 +114,7 @@ def main(argv=None):
     feature = "feature"
     label_dim = getattr(ds, "num_classes", 2) if ds else 2
     dims = [args.hidden_dim] * args.layers
+    flow = None  # set by families that evaluate/infer through a dataflow
 
     # ---- family dispatch -------------------------------------------------
     if name in KG_MODELS:
@@ -255,6 +256,43 @@ def main(argv=None):
         raise SystemExit(f"unknown model {name!r}")
 
     # ---- drive ----------------------------------------------------------
+    if args.mode != "train" and flow is None:
+        import jax.numpy as jnp
+
+        est.restore()
+        if name in KG_MODELS and args.mode == "evaluate":
+            from euler_tpu.models import kg_rank_eval
+
+            if ds is not None and hasattr(ds, "eval_triples") and not args.synthetic:
+                triples = ds.eval_triples("test")[:500]
+            else:  # offline fallback: rank sampled training edges
+                e = graph.sample_edge(200, rng=rng)
+                triples = np.stack(
+                    [e[:, 0], e[:, 2], e[:, 1]], axis=1
+                ).astype(np.int32)
+            print(kg_rank_eval(model, est.params, triples, num_entities=max_id))
+            return 0
+        if name in ("deepwalk", "node2vec", "line") and args.mode == "infer":
+            ids = np.concatenate(
+                [np.asarray(sh.node_ids) for sh in graph.shards]
+            )
+            emb = np.asarray(
+                model.apply(
+                    est.params,
+                    jnp.asarray(ids.astype(np.int64).astype(np.int32)),
+                    method=model.embed,
+                )
+            )
+            import os
+
+            os.makedirs(cfg.model_dir, exist_ok=True)
+            np.save(os.path.join(cfg.model_dir, "embedding_0.npy"), emb)
+            np.save(os.path.join(cfg.model_dir, "ids_0.npy"), ids)
+            print(f"wrote {emb.shape} embeddings to {cfg.model_dir}")
+            return 0
+        raise SystemExit(
+            f"mode {args.mode!r} is not supported for model {name!r}"
+        )
     if args.mode == "train":
         est.train()
     elif args.mode == "train_and_evaluate":

@@ -61,21 +61,41 @@ class GCNConv(Conv):
 
 
 class SAGEConv(Conv):
-    """GraphSAGE mean aggregator: W·[x_dst ‖ mean(x_src)] (sage_conv.py)."""
+    """GraphSAGE mean aggregator: W·[x_dst ‖ mean(x_src)] (sage_conv.py).
+
+    Grid-structured blocks can use the fused Pallas gather+reduce kernel
+    (mean = gather_weighted_sum with w = mask/deg), skipping the [E, F]
+    message tensor entirely.
+    """
 
     use_bias: bool = True
 
     @nn.compact
     def __call__(self, x_dst, x_src, block: Block):
-        msgs = self.msg(x_src, block)
-        total = self.agg_add(msgs, block)
-        count = scatter_add(
-            jnp.ones(block.edge_src.shape[0], jnp.float32),
-            block.edge_dst,
-            block.n_dst,
-            mask=block.mask,
-        )
-        mean = total / jnp.maximum(count, 1.0)[:, None]
+        from euler_tpu.ops import pallas_mode
+
+        mode = pallas_mode()
+        if block.grid and mode != "off":
+            d = block.grid
+            m = block.mask.reshape(-1, d).astype(jnp.float32)
+            w = m / jnp.maximum(m.sum(axis=1, keepdims=True), 1.0)
+            slots = block.edge_src.reshape(-1, d)
+            from euler_tpu.ops import gather_weighted_sum
+
+            # honor an explicit 'pallas' request (no silent XLA fallback)
+            impl = {"auto": "auto", "pallas": "pallas"}.get(mode, "interpret")
+            mean = gather_weighted_sum(x_src, slots, w, impl)
+            mean = mean.astype(x_dst.dtype)
+        else:
+            msgs = self.msg(x_src, block)
+            total = self.agg_add(msgs, block)
+            count = scatter_add(
+                jnp.ones(block.edge_src.shape[0], jnp.float32),
+                block.edge_dst,
+                block.n_dst,
+                mask=block.mask,
+            )
+            mean = total / jnp.maximum(count, 1.0)[:, None]
         h = jnp.concatenate([x_dst, mean], axis=-1)
         return nn.Dense(self.out_dim, use_bias=self.use_bias)(h)
 

@@ -1,0 +1,86 @@
+"""Neighborhood aggregators over padded [B, K, F] grids
+(tf_euler/python/utils/aggregators.py + sparse_aggregators.py parity):
+mean / meanpool / maxpool / gcn / attention.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Aggregator(nn.Module):
+    dim: int
+
+    def masked(self, nbr, mask):
+        return nbr * mask.astype(nbr.dtype)[..., None]
+
+
+class MeanAggregator(Aggregator):
+    @nn.compact
+    def __call__(self, self_x, nbr, mask):
+        m = mask.astype(jnp.float32)[..., None]
+        mean = jnp.sum(nbr * m, axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+        return nn.relu(
+            nn.Dense(self.dim)(self_x) + nn.Dense(self.dim, use_bias=False)(mean)
+        )
+
+
+class GCNAggregator(Aggregator):
+    @nn.compact
+    def __call__(self, self_x, nbr, mask):
+        m = mask.astype(jnp.float32)[..., None]
+        total = jnp.sum(nbr * m, axis=1) + self_x
+        mean = total / (m.sum(axis=1) + 1.0)
+        return nn.relu(nn.Dense(self.dim)(mean))
+
+
+class MeanPoolAggregator(Aggregator):
+    @nn.compact
+    def __call__(self, self_x, nbr, mask):
+        h = nn.relu(nn.Dense(self.dim)(nbr))
+        m = mask.astype(jnp.float32)[..., None]
+        pooled = jnp.sum(h * m, axis=1) / jnp.maximum(m.sum(axis=1), 1.0)
+        return nn.relu(
+            nn.Dense(self.dim)(self_x) + nn.Dense(self.dim, use_bias=False)(pooled)
+        )
+
+
+class MaxPoolAggregator(Aggregator):
+    @nn.compact
+    def __call__(self, self_x, nbr, mask):
+        h = nn.relu(nn.Dense(self.dim)(nbr))
+        neg = jnp.finfo(h.dtype).min
+        pooled = jnp.max(jnp.where(mask[..., None], h, neg), axis=1)
+        pooled = jnp.where(mask.any(axis=1)[:, None], pooled, 0.0)
+        return nn.relu(
+            nn.Dense(self.dim)(self_x) + nn.Dense(self.dim, use_bias=False)(pooled)
+        )
+
+
+class AttentionAggregator(Aggregator):
+    @nn.compact
+    def __call__(self, self_x, nbr, mask):
+        q = nn.Dense(self.dim)(self_x)  # [B, D]
+        k = nn.Dense(self.dim)(nbr)  # [B, K, D]
+        e = jnp.einsum("bd,bkd->bk", q, k) / jnp.sqrt(float(self.dim))
+        e = jnp.where(mask, e, jnp.finfo(e.dtype).min)
+        alpha = nn.softmax(e, axis=1)
+        alpha = jnp.where(mask, alpha, 0.0)
+        out = jnp.einsum("bk,bkd->bd", alpha, k)
+        return nn.relu(q + out)
+
+
+AGGREGATORS = {
+    "mean": MeanAggregator,
+    "gcn": GCNAggregator,
+    "meanpool": MeanPoolAggregator,
+    "maxpool": MaxPoolAggregator,
+    "attention": AttentionAggregator,
+}
+
+
+def get_aggregator(name: str):
+    if name not in AGGREGATORS:
+        raise KeyError(f"unknown aggregator {name!r}; have {sorted(AGGREGATORS)}")
+    return AGGREGATORS[name]

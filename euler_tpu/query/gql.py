@@ -33,6 +33,7 @@ _TOKEN = re.compile(
 
 
 def _tokenize(src: str):
+    src = src.strip()
     pos = 0
     out = []
     while pos < len(src):
@@ -84,12 +85,18 @@ def _parse(src: str) -> list[tuple[str, list]]:
                     while toks[i] != ("punct", "]"):
                         if toks[i][0] in ("num", "str"):
                             lst.append(toks[i][1])
+                        elif toks[i] == ("punct", ","):
+                            pass
+                        else:
+                            raise SyntaxError(
+                                f"unexpected {toks[i][1]!r} inside [...] "
+                                "(only literals allowed)"
+                            )
                         i += 1
                     i += 1
                     args.append(lst)
                 else:
-                    i += 1
-                    continue
+                    raise SyntaxError(f"unexpected {val!r} in argument list")
                 if i < len(toks) and toks[i] == ("punct", ","):
                     i += 1
             expect("punct", ")")
@@ -176,9 +183,14 @@ class Query:
                 last = cur
             elif fn == "limit":
                 n = int(args[0])
-                cur = cur[:n]
-                if isinstance(last, np.ndarray):
-                    last = last[:n]
+                if isinstance(last, tuple):
+                    # row-wise truncation of the previous step's result
+                    last = tuple(x[:n] for x in last)
+                    cur = np.asarray(last[0]).reshape(-1)
+                else:
+                    cur = cur[:n]
+                    if isinstance(last, np.ndarray):
+                        last = last[:n]
             elif fn == "order_by":
                 if not (isinstance(last, tuple) and len(last) == 4):
                     raise ValueError("order_by follows a neighbor step")

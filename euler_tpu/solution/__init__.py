@@ -1,0 +1,114 @@
+"""Composable solution pipelines (tf_euler/python/solution parity).
+
+The reference builds supervised/unsupervised models from four pluggable
+parts — (get_label_fn, encoder_fn, logit_fn, loss_fn)
+(solution/base_supervise.py:26-50). Here a Solution is a flax module wired
+from the same parts: an encoder module, a logits head, and a loss; samplers
+come from the estimator batch sources.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from euler_tpu.nn.metrics import METRICS
+
+
+class DenseLogits(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, emb, *_):
+        return nn.Dense(self.num_classes)(emb)
+
+
+class CosineLogits(nn.Module):
+    """Cosine similarity between two embeddings (logits.py parity)."""
+
+    scale: float = 10.0
+
+    @nn.compact
+    def __call__(self, a, b):
+        na = a / jnp.maximum(jnp.linalg.norm(a, axis=-1, keepdims=True), 1e-9)
+        nb = b / jnp.maximum(jnp.linalg.norm(b, axis=-1, keepdims=True), 1e-9)
+        return self.scale * jnp.sum(na * nb, axis=-1)
+
+
+class PosNegLogits(nn.Module):
+    """[pos | negs] logit matrix from (src, pos, negs) embeddings."""
+
+    @nn.compact
+    def __call__(self, src, pos, negs):
+        b, d = src.shape
+        negs = negs.reshape(b, -1, d)
+        pos_l = jnp.sum(src * pos, axis=-1)
+        neg_l = jnp.einsum("bd,bnd->bn", src, negs)
+        return jnp.concatenate([pos_l[:, None], neg_l], axis=1)
+
+
+def sigmoid_loss(logits, labels):
+    return jnp.mean(
+        jnp.sum(optax.sigmoid_binary_cross_entropy(logits, labels), axis=-1)
+    )
+
+
+def softmax_loss(logits, labels):
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    )
+
+
+LOSSES = {"sigmoid": sigmoid_loss, "softmax": softmax_loss}
+
+
+class SuperviseSolution(nn.Module):
+    """encoder → logits → loss with a configurable metric."""
+
+    encoder: nn.Module
+    num_classes: int
+    loss: str = "sigmoid"
+    metric: str = "f1"
+
+    def setup(self):
+        self.head = DenseLogits(self.num_classes)
+
+    def embed(self, batch):
+        return self.encoder(batch)
+
+    def __call__(self, batch):
+        emb = self.encoder(batch)
+        logits = self.head(emb)
+        labels = batch.labels
+        if self.loss == "softmax":
+            loss = softmax_loss(logits, jnp.argmax(labels, -1))
+        else:
+            loss = sigmoid_loss(logits, labels)
+        metric = METRICS[self.metric](labels, logits)
+        return emb, loss, self.metric, metric
+
+
+class UnsuperviseSolution(nn.Module):
+    """encoder + PosNegLogits + softmax ranking loss, MRR metric."""
+
+    encoder: nn.Module
+
+    def setup(self):
+        self.logits = PosNegLogits()
+
+    def embed(self, batch):
+        return self.encoder(batch)
+
+    def __call__(self, src, pos, negs):
+        from euler_tpu.nn.metrics import mrr
+
+        e_s = self.encoder(src)
+        e_p = self.encoder(pos)
+        e_n = self.encoder(negs)
+        logits = self.logits(e_s, e_p, e_n)
+        labels = jnp.zeros(e_s.shape[0], dtype=jnp.int32)
+        loss = softmax_loss(logits, labels)
+        return e_s, loss, "mrr", mrr(logits[:, 0], logits[:, 1:])

@@ -1,0 +1,96 @@
+"""KNN retrieval over inferred embeddings (knn/knn.py parity).
+
+The reference wraps faiss IVFFlat (knn.py:36-53); on TPU brute-force
+matmul + top-k IS the fast path (MXU does the distance matrix), so this is a
+jitted exact search with chunking — no index build, no approximation.
+
+Usage:
+    python -m euler_tpu.tools.knn --model-dir DIR --k 10 [--query-ids 1 2 3]
+reads embedding_{w}.npy / ids_{w}.npy written by Estimator.infer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+import numpy as np
+
+
+def knn_search(
+    embeddings: np.ndarray,
+    queries: np.ndarray,
+    k: int = 10,
+    metric: str = "ip",  # ip | l2 | cosine
+    chunk: int = 1024,
+):
+    """Exact top-k: returns (indices [Q, k], scores [Q, k])."""
+    import jax
+    import jax.numpy as jnp
+
+    base = jnp.asarray(embeddings, jnp.float32)
+    if metric == "cosine":
+        base = base / jnp.maximum(
+            jnp.linalg.norm(base, axis=1, keepdims=True), 1e-9
+        )
+    base_sq = jnp.sum(base * base, axis=1)
+
+    @jax.jit
+    def search(q):
+        if metric == "cosine":
+            q = q / jnp.maximum(jnp.linalg.norm(q, axis=1, keepdims=True), 1e-9)
+        sims = q @ base.T
+        if metric == "l2":
+            qsq = jnp.sum(q * q, axis=1, keepdims=True)
+            sims = -(qsq - 2 * sims + base_sq[None, :])
+        return jax.lax.top_k(sims, k)
+
+    idxs, scores = [], []
+    queries = np.asarray(queries, np.float32)
+    for i in range(0, len(queries), chunk):
+        q = queries[i : i + chunk]
+        pad = chunk - len(q)
+        if pad:
+            q = np.pad(q, ((0, pad), (0, 0)))
+        s, ix = search(jnp.asarray(q))
+        idxs.append(np.asarray(ix)[: len(queries[i : i + chunk])])
+        scores.append(np.asarray(s)[: len(queries[i : i + chunk])])
+    return np.concatenate(idxs), np.concatenate(scores)
+
+
+def load_inferred(model_dir: str) -> tuple[np.ndarray, np.ndarray]:
+    """Concatenate embedding_{w}.npy / ids_{w}.npy across workers."""
+    embs, ids = [], []
+    for path in sorted(glob.glob(os.path.join(model_dir, "embedding_*.npy"))):
+        w = os.path.basename(path)[len("embedding_") : -len(".npy")]
+        embs.append(np.load(path))
+        ids.append(np.load(os.path.join(model_dir, f"ids_{w}.npy")))
+    if not embs:
+        raise FileNotFoundError(f"no embedding_*.npy under {model_dir}")
+    return np.concatenate(ids), np.concatenate(embs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--metric", default="ip", choices=["ip", "l2", "cosine"])
+    ap.add_argument("--query-ids", type=int, nargs="*", default=None)
+    args = ap.parse_args(argv)
+    ids, embs = load_inferred(args.model_dir)
+    if args.query_ids:
+        pos = {int(i): r for r, i in enumerate(ids)}
+        rows = [pos[q] for q in args.query_ids]
+        queries = embs[rows]
+    else:
+        queries = embs[:5]
+    idx, score = knn_search(embs, queries, args.k, args.metric)
+    for qi, (row, sc) in enumerate(zip(idx, score)):
+        pairs = ", ".join(f"{int(ids[r])}({s:.3f})" for r, s in zip(row, sc))
+        print(f"query {qi}: {pairs}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

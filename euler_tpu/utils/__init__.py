@@ -1,0 +1,1 @@
+from euler_tpu.utils.hooks import SyncExit  # noqa: F401

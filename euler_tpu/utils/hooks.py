@@ -1,0 +1,39 @@
+"""Training-run coordination utilities.
+
+`SyncExit` is the reference's SyncExitHook (tf_euler/python/utils/hooks.py:
+25-35): in a multi-worker run each worker marks itself done on the shared
+filesystem; the chief blocks until all have exited before tearing down
+shared services. The PS variable counter becomes marker files next to the
+membership registry.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class SyncExit:
+    def __init__(self, path: str, worker: int, num_workers: int):
+        self.path = path
+        self.worker = worker
+        self.num_workers = num_workers
+        os.makedirs(path, exist_ok=True)
+
+    def mark_done(self):
+        with open(os.path.join(self.path, f"done_{self.worker}"), "w") as f:
+            f.write(str(time.time()))
+
+    def wait_all(self, timeout: float = 600.0, poll: float = 0.5):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            done = sum(
+                os.path.exists(os.path.join(self.path, f"done_{w}"))
+                for w in range(self.num_workers)
+            )
+            if done >= self.num_workers:
+                return True
+            time.sleep(poll)
+        raise TimeoutError(
+            f"sync_exit: only {done}/{self.num_workers} workers done"
+        )

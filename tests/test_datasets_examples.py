@@ -63,3 +63,28 @@ def test_run_model_data_parallel(tmp_path):
         "--data-parallel", "8", "--log-steps", "1000",
     ])
     assert rc == 0 or rc is None
+
+
+def test_kg_evaluate_mode(tmp_path):
+    for mode in ("train", "evaluate"):
+        rc = run_model([
+            "--model", "transe", "--dataset", "fb15k", "--synthetic",
+            "--total-steps", "3", "--batch-size", "8", "--embedding-dim", "8",
+            "--model-dir", str(tmp_path), "--log-steps", "1000",
+            "--mode", mode,
+        ])
+        assert rc == 0 or rc is None
+
+
+def test_deepwalk_infer_mode(tmp_path):
+    for mode in ("train", "infer"):
+        rc = run_model([
+            "--model", "deepwalk", "--dataset", "cora", "--synthetic",
+            "--total-steps", "3", "--batch-size", "4", "--embedding-dim", "8",
+            "--model-dir", str(tmp_path), "--log-steps", "1000",
+            "--mode", mode,
+        ])
+        assert rc == 0 or rc is None
+    import os
+    out = os.path.join(str(tmp_path), "deepwalk_cora")
+    assert os.path.exists(os.path.join(out, "embedding_0.npy"))

@@ -96,3 +96,19 @@ def test_query_reuse(g, rng):
     for ids in ([1, 2], [3, 4, 5]):
         res = q.run(g, {"roots": np.asarray(ids, np.uint64)}, rng=rng)
         assert res["nb"][0].shape == (len(ids), 2)
+
+
+def test_trailing_whitespace(g):
+    res = run_gql(g, " v([1, 2]).get().as(x) \n")
+    assert len(res["x"]) == 2
+
+
+def test_limit_truncates_rows(g, rng):
+    res = run_gql(g, "v([1, 2, 3]).sampleNB(0, 1, 4).limit(2).as(nb)", rng=rng)
+    nbr, w, tt, mask = res["nb"]
+    assert nbr.shape == (2, 4)
+
+
+def test_bad_list_token_raises():
+    with pytest.raises(SyntaxError, match="inside"):
+        Query("v([nodes]).get().as(x)")

@@ -1,0 +1,123 @@
+"""Aggregators, KNN tool, spmm contrib, solution pipelines, sync hooks,
+estimator profiling."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from euler_tpu.contrib import spmm_aggregate
+from euler_tpu.nn.aggregators import AGGREGATORS, get_aggregator
+from euler_tpu.tools.knn import knn_search
+from euler_tpu.utils import SyncExit
+
+
+@pytest.mark.parametrize("name", sorted(AGGREGATORS))
+def test_aggregators(name, rng):
+    agg = get_aggregator(name)(dim=8)
+    self_x = jnp.asarray(rng.normal(size=(4, 6)), jnp.float32)
+    nbr = jnp.asarray(rng.normal(size=(4, 5, 6)), jnp.float32)
+    mask = jnp.asarray(rng.random((4, 5)) > 0.3)
+    params = agg.init(jax.random.PRNGKey(0), self_x, nbr, mask)
+    out = agg.apply(params, self_x, nbr, mask)
+    assert out.shape == (4, 8)
+    assert jnp.isfinite(out).all()
+
+
+def test_knn_exact(rng):
+    base = rng.normal(size=(50, 16)).astype(np.float32)
+    idx, score = knn_search(base, base[:3], k=5, metric="cosine")
+    # nearest neighbor of each query is itself
+    assert idx[:, 0].tolist() == [0, 1, 2]
+    np.testing.assert_allclose(score[:, 0], 1.0, rtol=1e-5)
+    idx_l2, _ = knn_search(base, base[:3], k=5, metric="l2")
+    assert idx_l2[:, 0].tolist() == [0, 1, 2]
+
+
+def test_knn_cli(tmp_path, rng):
+    from euler_tpu.tools.knn import main
+
+    emb = rng.normal(size=(20, 8)).astype(np.float32)
+    ids = np.arange(100, 120, dtype=np.uint64)
+    np.save(tmp_path / "embedding_0.npy", emb)
+    np.save(tmp_path / "ids_0.npy", ids)
+    assert main(["--model-dir", str(tmp_path), "--k", "3"]) == 0
+
+
+def test_spmm_matches_segment(rng):
+    from euler_tpu.ops import scatter_add
+
+    x = jnp.asarray(rng.normal(size=(6, 4)), jnp.float32)
+    src = jnp.asarray([0, 1, 2, 3, 4, 5])
+    dst = jnp.asarray([0, 0, 1, 1, 2, 2])
+    w = jnp.asarray(rng.random(6), jnp.float32)
+    out = spmm_aggregate(src, dst, w, x, n_dst=3)
+    ref = scatter_add(x * w[:, None], dst, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_solution_supervised(rng):
+    import sys
+
+    sys.path.insert(0, "tests")
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.nn import GNNNet
+    from euler_tpu.solution import SuperviseSolution
+    from test_training import make_cluster_graph
+
+    g = make_cluster_graph()
+    nprng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        g, ["feat"], fanouts=[3], label_feature="label", rng=nprng
+    )
+    model = SuperviseSolution(
+        encoder=GNNNet(conv="gcn", dims=[8]), num_classes=2
+    )
+    cfg = EstimatorConfig(
+        model_dir="/tmp/etpu_sol", total_steps=10, learning_rate=0.05,
+        log_steps=10**9,
+    )
+    est = Estimator(model, node_batches(g, flow, 8, rng=nprng), cfg)
+    hist = est.train(save=False)
+    assert hist[-1] < hist[0]
+
+
+def test_sync_exit(tmp_path):
+    h0 = SyncExit(str(tmp_path), 0, 2)
+    h1 = SyncExit(str(tmp_path), 1, 2)
+    h0.mark_done()
+    with pytest.raises(TimeoutError):
+        h0.wait_all(timeout=0.5)
+    h1.mark_done()
+    assert h0.wait_all(timeout=2)
+
+
+def test_estimator_profiling(tmp_path):
+    import sys
+
+    sys.path.insert(0, "tests")
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.nn import SuperviseModel
+    from test_training import make_cluster_graph
+
+    g = make_cluster_graph()
+    nprng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        g, ["feat"], fanouts=[2], label_feature="label", rng=nprng
+    )
+    model = SuperviseModel(conv="sage", dims=[8], label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "m"),
+        total_steps=4,
+        log_steps=10**9,
+        profile_dir=str(tmp_path / "prof"),
+        profile_start_step=1,
+        profile_steps=2,
+    )
+    est = Estimator(model, node_batches(g, flow, 4, rng=nprng), cfg)
+    est.train(save=False)
+    assert os.path.exists(str(tmp_path / "prof"))
