@@ -58,8 +58,6 @@ class PlanetoidDataset(Dataset):
             tx_ext[sorted_test - lo] = tx_dense
             ty_ext[sorted_test - lo] = ty_dense
             tx_dense, ty_dense = tx_ext, ty_ext
-            sorted_test = np.arange(lo, hi + 1)
-            test_idx = sorted_test
         feats = np.vstack([np.asarray(allx.todense()), tx_dense])
         labels = np.vstack([np.asarray(ally), ty_dense])
         # standard fixup: the test block arrives permuted by test.index
@@ -286,16 +284,19 @@ class KGDataset(Dataset):
                 out.append((h, r, t))
         return out
 
-    def build_json(self) -> dict:
-        train = self._triples("train")
+    def _build_maps(self):
+        """Deterministic entity/relation id maps derived from train.txt."""
         ents, rels = {}, {}
-        for h, r, t in train:
+        for h, r, t in self._triples("train"):
             ents.setdefault(h, len(ents) + 1)
             ents.setdefault(t, len(ents) + 1)
             rels.setdefault(r, len(rels))
         self.entity_map, self.relation_map = ents, rels
-        with open(os.path.join(self.root, "id_maps.json"), "w") as f:
-            json.dump({"entities": ents, "relations": rels}, f)
+
+    def build_json(self) -> dict:
+        self._build_maps()
+        ents, rels = self.entity_map, self.relation_map
+        train = self._triples("train")
         nodes = [
             {"id": i, "type": 0, "weight": 1.0, "features": []}
             for i in ents.values()
@@ -315,17 +316,7 @@ class KGDataset(Dataset):
     def eval_triples(self, split: str = "test") -> np.ndarray:
         """int32 [M, 3] (h, r, t) restricted to known entities/relations."""
         if not self.entity_map:
-            # maps persist across runs (build_json only runs on conversion)
-            maps_path = os.path.join(self.root, "id_maps.json")
-            if not os.path.exists(maps_path):
-                raise FileNotFoundError(
-                    f"{maps_path} missing — load_graph(synthetic=False) must "
-                    "have built the real dataset before eval_triples"
-                )
-            with open(maps_path) as f:
-                maps = json.load(f)
-            self.entity_map = maps["entities"]
-            self.relation_map = maps["relations"]
+            self._build_maps()
         out = []
         for h, r, t in self._triples(split):
             if h in self.entity_map and t in self.entity_map and r in self.relation_map:

@@ -158,15 +158,14 @@ class Estimator:
             ):
                 jax.profiler.start_trace(self.cfg.profile_dir)
                 profiling = True
+                profile_stop = self.step + self.cfg.profile_steps
                 self._profiled = True
             batch = self._put(self.batch_fn())
             self.params, self.opt_state, loss, metric = step_fn(
                 self.params, self.opt_state, self._rngs(self.step), *batch
             )
             self.step += 1
-            if profiling and self.step >= (
-                self.cfg.profile_start_step + self.cfg.profile_steps
-            ):
+            if profiling and self.step >= profile_stop:
                 jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
                 profiling = False

@@ -1,0 +1,357 @@
+"""Attribute sample-indexes + DNF condition algebra (conditioned sampling).
+
+TPU-native counterpart of the reference index subsystem
+(euler/core/index/sample_index.h:30-60, index_manager.h:35-58,
+common_index_result.h): `HashIndex` answers eq/in over discrete attribute
+values, `RangeIndex` answers lt/le/gt/ge/eq over ordered scalars with
+prefix-sum weights for O(log n) weighted sampling, `HashRangeIndex` nests a
+range index under each hash key. Search results are `IndexResult` row sets
+supporting intersection/union so DNF filter conditions
+(`has/hasKey/hasLabel`, gremlin.l:15-56) compose, then sample by weight or
+materialize ids. Everything is vectorized numpy over the shard's columnar
+arrays — no per-row trees.
+
+A condition is DNF: a list of AND-clauses, each clause a list of atoms
+`(field, op, value)`; the whole condition is the OR of its clauses.
+Fields: any feature name, or the specials `id`, `type`, `weight`.
+Ops: eq ne lt le gt ge in not_in haskey.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from euler_tpu.graph.meta import BINARY, DENSE, SPARSE
+
+OPS = ("eq", "ne", "lt", "le", "gt", "ge", "in", "not_in", "haskey")
+
+
+class IndexResult:
+    """A set of local row indices with the shard's sampling weights.
+
+    Mirrors the reference's lazy IndexResult set algebra
+    (euler/core/index/common_index_result.h) eagerly: rows are kept sorted
+    and unique so intersection/union are linear merges.
+    """
+
+    def __init__(self, rows: np.ndarray, weights: np.ndarray):
+        self.rows = np.asarray(rows, dtype=np.int64)
+        self._weights = weights  # full per-row weight column (shared)
+
+    def intersect(self, other: "IndexResult") -> "IndexResult":
+        return IndexResult(
+            np.intersect1d(self.rows, other.rows, assume_unique=True),
+            self._weights,
+        )
+
+    def union(self, other: "IndexResult") -> "IndexResult":
+        return IndexResult(
+            np.union1d(self.rows, other.rows), self._weights
+        )
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    @property
+    def total_weight(self) -> float:
+        return float(self._weights[self.rows].sum()) if len(self.rows) else 0.0
+
+    def sample(self, count: int, rng: np.random.Generator) -> np.ndarray:
+        """Weighted sample (with replacement) of `count` rows; -1 if empty."""
+        if len(self.rows) == 0:
+            return np.full(count, -1, dtype=np.int64)
+        w = np.asarray(self._weights[self.rows], dtype=np.float64)
+        cum = np.cumsum(w)
+        if cum[-1] <= 0:
+            return np.full(count, -1, dtype=np.int64)
+        u = rng.random(count) * cum[-1]
+        return self.rows[np.searchsorted(cum, u, side="right")]
+
+    def contains(self, rows: np.ndarray) -> np.ndarray:
+        """Membership mask for arbitrary row indices (vectorized)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if len(self.rows) == 0:
+            return np.zeros(rows.shape, dtype=bool)
+        pos = np.searchsorted(self.rows, rows)
+        pos = np.clip(pos, 0, len(self.rows) - 1)
+        return (self.rows[pos] == rows) & (rows >= 0)
+
+
+class HashIndex:
+    """value → rows, for discrete (u64 / bytes / int) attributes.
+
+    Parity: HashSampleIndex (euler/core/index/hash_sample_index.h). Rows may
+    appear under several values (multi-valued sparse attributes).
+    """
+
+    def __init__(self, table: dict, num_rows: int, nonempty: np.ndarray):
+        self._table = table  # value → sorted row array
+        self._num_rows = num_rows
+        self._nonempty = nonempty  # sorted rows that carry the attribute
+
+    @classmethod
+    def build(cls, rows: np.ndarray, values: np.ndarray, num_rows: int):
+        order = np.argsort(values, kind="stable")
+        rows, values = rows[order], values[order]
+        table = {}
+        if len(values):
+            cuts = np.flatnonzero(np.r_[True, values[1:] != values[:-1]])
+            bounds = np.r_[cuts, len(values)]
+            for i, c in enumerate(cuts):
+                v = values[c]
+                table[v.item() if isinstance(v, np.generic) else v] = np.sort(
+                    rows[c : bounds[i + 1]]
+                )
+        return cls(table, num_rows, np.unique(rows))
+
+    def _all(self) -> np.ndarray:
+        return np.arange(self._num_rows, dtype=np.int64)
+
+    def search(self, op: str, value) -> np.ndarray:
+        if op == "haskey":
+            return self._nonempty
+        if op == "eq":
+            return self._table.get(_key(value), np.empty(0, np.int64))
+        if op == "in":
+            hits = [
+                self._table.get(_key(v), np.empty(0, np.int64)) for v in value
+            ]
+            return _union_many(hits)
+        if op == "ne":
+            return np.setdiff1d(self._all(), self.search("eq", value))
+        if op == "not_in":
+            return np.setdiff1d(self._all(), self.search("in", value))
+        raise ValueError(f"hash index does not support op {op!r}")
+
+
+class RangeIndex:
+    """Ordered scalar attribute → row ranges via binary search.
+
+    Parity: RangeSampleIndex (euler/core/index/range_sample_index.h) —
+    sorted (value, row) pairs; lt/le/gt/ge/eq become contiguous slices of
+    the sort order, sampled through the shared weight column.
+    """
+
+    def __init__(self, sorted_vals: np.ndarray, order_rows: np.ndarray):
+        self._vals = sorted_vals
+        self._rows = order_rows
+
+    @classmethod
+    def build(cls, values: np.ndarray):
+        values = np.asarray(values)
+        # integers (incl. uint64 node ids) stay exact; everything else
+        # compares as float64
+        if not np.issubdtype(values.dtype, np.integer):
+            values = values.astype(np.float64)
+        order = np.argsort(values, kind="stable")
+        return cls(values[order], order.astype(np.int64))
+
+    def _coerce(self, value):
+        """Search value → the index dtype; None = below an unsigned domain."""
+        dt = self._vals.dtype
+        integral = isinstance(value, (int, np.integer)) or (
+            isinstance(value, float) and value.is_integer()
+        )
+        if np.issubdtype(dt, np.integer):
+            if not integral:
+                # fractional threshold over an integer column: compares as
+                # float64 (exactness above 2**53 is not preserved here)
+                return float(value)
+            if int(value) < 0 and np.issubdtype(dt, np.unsignedinteger):
+                return None
+            return dt.type(int(value))
+        return float(value)
+
+    def search(self, op: str, value) -> np.ndarray:
+        n = len(self._vals)
+        if op == "in":
+            return _union_many([self.search("eq", x) for x in value])
+        if op == "not_in":
+            return np.setdiff1d(np.sort(self._rows), self.search("in", value))
+        if op == "haskey":
+            return np.sort(self._rows)
+        v = self._coerce(value)
+        if v is None:  # negative value vs unsigned column
+            if op in ("lt", "le", "eq"):
+                return np.empty(0, np.int64)
+            return np.sort(self._rows)  # gt/ge/ne match everything
+        if op == "lt":
+            sl = slice(0, np.searchsorted(self._vals, v, "left"))
+        elif op == "le":
+            sl = slice(0, np.searchsorted(self._vals, v, "right"))
+        elif op == "gt":
+            sl = slice(np.searchsorted(self._vals, v, "right"), n)
+        elif op == "ge":
+            sl = slice(np.searchsorted(self._vals, v, "left"), n)
+        elif op == "eq":
+            sl = slice(
+                np.searchsorted(self._vals, v, "left"),
+                np.searchsorted(self._vals, v, "right"),
+            )
+        elif op == "ne":
+            return np.sort(
+                np.r_[
+                    self._rows[: np.searchsorted(self._vals, v, "left")],
+                    self._rows[np.searchsorted(self._vals, v, "right") :],
+                ]
+            )
+        else:
+            raise ValueError(f"range index does not support op {op!r}")
+        return np.sort(self._rows[sl])
+
+
+class HashRangeIndex:
+    """key → RangeIndex over (key, value) pair attributes.
+
+    Parity: HashRangeSampleIndex — hash on the first component, range
+    search within. Entries come as (row, key, value) triples.
+    """
+
+    def __init__(self, table: dict):
+        self._table = table  # key → (RangeIndex over values, rows base)
+
+    @classmethod
+    def build(cls, rows: np.ndarray, keys: np.ndarray, values: np.ndarray):
+        table = {}
+        order = np.argsort(keys, kind="stable")
+        rows, keys, values = rows[order], keys[order], values[order]
+        if len(keys):
+            cuts = np.flatnonzero(np.r_[True, keys[1:] != keys[:-1]])
+            bounds = np.r_[cuts, len(keys)]
+            for i, c in enumerate(cuts):
+                seg = slice(c, bounds[i + 1])
+                sub_vals = np.asarray(values[seg], dtype=np.float64)
+                sub_order = np.argsort(sub_vals, kind="stable")
+                k = keys[c]
+                table[k.item() if isinstance(k, np.generic) else k] = RangeIndex(
+                    sub_vals[sub_order], rows[seg][sub_order]
+                )
+        return cls(table)
+
+    def search(self, key, op: str, value) -> np.ndarray:
+        sub = self._table.get(_key(key))
+        if sub is None:
+            return np.empty(0, np.int64)
+        return sub.search(op, value)
+
+
+def _key(v):
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode()
+    if isinstance(v, float) and v.is_integer():
+        return int(v)
+    return int(v) if isinstance(v, (int, np.integer)) else v
+
+
+def _union_many(parts: list[np.ndarray]) -> np.ndarray:
+    parts = [p for p in parts if len(p)]
+    if not parts:
+        return np.empty(0, np.int64)
+    return np.unique(np.concatenate(parts))
+
+
+class IndexManager:
+    """Per-shard index registry + DNF evaluator.
+
+    Parity: IndexManager::Instance() (index_manager.h:35-58) except indexes
+    are (re)built from the memory-mapped columns at first use instead of
+    being deserialized from an `Index/` directory — the columnar shard
+    format already holds every value the offline index files would.
+    Un-indexed fields fall back to a vectorized full-column scan with the
+    same semantics.
+    """
+
+    def __init__(self, store, node: bool = True):
+        self._store = store
+        self._node = node
+        self._cache: dict[tuple, object] = {}
+        meta = store.meta
+        n = store.num_nodes if node else len(store.edge_src)
+        self._num_rows = n
+        self._weights = store.node_weights if node else store.edge_weights
+
+    # ---- column extraction ---------------------------------------------
+
+    def _column(self, field: str):
+        """(kind, data) for a field: scalar column or (rows, values) pairs."""
+        st = self._store
+        if field == "id":
+            return "scalar", (
+                st.node_ids
+                if self._node
+                else np.arange(self._num_rows, dtype=np.int64)
+            )
+        if field in ("type", "label", "__label__"):
+            col = st.node_types if self._node else st.edge_types
+            return "scalar", np.asarray(col, dtype=np.int64)
+        if field == "weight":
+            return "scalar", np.asarray(self._weights, dtype=np.float64)
+        spec = st.meta.feature_spec(field, node=self._node)
+        prefix = "nf" if self._node else "ef"
+        if spec.kind == DENSE:
+            vals = np.asarray(st._feat(prefix, DENSE, spec.fid))
+            return "scalar", vals[:, 0].astype(np.float64)
+        if spec.kind == SPARSE:
+            indptr = st._feat(prefix, SPARSE, spec.fid, "_indptr")
+            values = np.asarray(st._feat(prefix, SPARSE, spec.fid, "_values"))
+            rows = np.repeat(
+                np.arange(self._num_rows, dtype=np.int64), np.diff(indptr)
+            )
+            return "multi", (rows, values)
+        if spec.kind == BINARY:
+            indptr = st._feat(prefix, BINARY, spec.fid, "_indptr")
+            blob = np.asarray(st._feat(prefix, BINARY, spec.fid, "_values"))
+            vals = np.array(
+                [
+                    bytes(blob[indptr[r] : indptr[r + 1]])
+                    for r in range(self._num_rows)
+                ],
+                dtype=object,
+            )
+            rows = np.arange(self._num_rows, dtype=np.int64)
+            keep = np.array([len(v) > 0 for v in vals], dtype=bool)
+            return "multi", (rows[keep], vals[keep])
+        raise ValueError(f"cannot index feature kind {spec.kind!r}")
+
+    def _index_for(self, field: str):
+        """Scalar fields get a RangeIndex (covers eq/ne/in + ordering ops);
+        multi-valued sparse/binary fields get a HashIndex."""
+        if field in self._cache:
+            return self._cache[field]
+        kind, data = self._column(field)
+        if kind == "scalar":
+            idx = RangeIndex.build(data)
+        else:
+            rows, values = data
+            idx = HashIndex.build(rows, values, self._num_rows)
+        self._cache[field] = idx
+        return idx
+
+    # ---- DNF evaluation -------------------------------------------------
+
+    def search(self, field: str, op: str, value=None) -> IndexResult:
+        if op not in OPS:
+            raise ValueError(f"unknown condition op {op!r}")
+        return IndexResult(
+            self._index_for(field).search(op, value), self._weights
+        )
+
+    def search_dnf(self, dnf) -> IndexResult:
+        """dnf = [[(field, op, value), ...AND...], ...OR...]."""
+        out: IndexResult | None = None
+        for clause in dnf:
+            cur: IndexResult | None = None
+            for atom in clause:
+                field, op, value = (tuple(atom) + (None,))[:3]
+                res = self.search(field, op, value)
+                cur = res if cur is None else cur.intersect(res)
+            if cur is None:
+                continue
+            out = cur if out is None else out.union(cur)
+        if out is None:
+            out = IndexResult(
+                np.arange(self._num_rows, dtype=np.int64), self._weights
+            )
+        return out

@@ -30,6 +30,15 @@ from euler_tpu.graph.meta import BINARY, DENSE, SPARSE, GraphMeta
 DEFAULT_ID = np.uint64(0xFFFFFFFFFFFFFFFF)  # padding sentinel for node ids
 
 
+def _fold_type(dnf, type_id: int):
+    """AND a `type == type_id` atom into every DNF clause (no-op if < 0)."""
+    if type_id < 0:
+        return dnf
+    return [list(clause) + [("type", "eq", type_id)] for clause in dnf] or [
+        [("type", "eq", type_id)]
+    ]
+
+
 def _rng(rng) -> np.random.Generator:
     return rng if rng is not None else np.random.default_rng()
 
@@ -167,6 +176,8 @@ class GraphStore:
         ]
         self._edge_sampler_all = _WeightedSampler(self.edge_weights)
         self._edge_key_index: dict | None = None
+        self._index_mgr = None
+        self._edge_index_mgr = None
 
     # ---- id resolution -------------------------------------------------
 
@@ -508,6 +519,78 @@ class GraphStore:
             )
         return out
 
+    # ---- attribute indexes / conditioned sampling ----------------------
+    # (euler/core/index parity: IndexManager + SampleIndex::Search feeding
+    #  conditioned sample_node and the API_GET_NB_FILTER path)
+
+    @property
+    def index_manager(self):
+        if self._index_mgr is None:
+            from euler_tpu.graph.index import IndexManager
+
+            self._index_mgr = IndexManager(self, node=True)
+        return self._index_mgr
+
+    @property
+    def edge_index_manager(self):
+        if self._edge_index_mgr is None:
+            from euler_tpu.graph.index import IndexManager
+
+            self._edge_index_mgr = IndexManager(self, node=False)
+        return self._edge_index_mgr
+
+    def search_condition(self, dnf, node: bool = True):
+        mgr = self.index_manager if node else self.edge_index_manager
+        return mgr.search_dnf(dnf)
+
+    def sample_node_with_condition(
+        self, count: int, dnf, node_type: int = -1, rng=None
+    ) -> np.ndarray:
+        """Weighted node sampling restricted to rows matching a DNF condition."""
+        res = self.search_condition(_fold_type(dnf, node_type))
+        return self.sample_from_result(res, count, rng)
+
+    def sample_from_result(self, res, count: int, rng=None) -> np.ndarray:
+        """Sample node ids from an already-computed IndexResult."""
+        rng = _rng(rng)
+        rowz = res.sample(count, rng)
+        out = np.full(count, DEFAULT_ID, dtype=np.uint64)
+        ok = rowz >= 0
+        out[ok] = self.node_ids[rowz[ok]]
+        return out
+
+    def sample_edge_with_condition(
+        self, count: int, dnf, edge_type: int = -1, rng=None
+    ) -> np.ndarray:
+        """Exact-count conditioned edge sampling → [count, 3] (src,dst,type)."""
+        res = self.search_condition(_fold_type(dnf, edge_type), node=False)
+        return self.sample_edges_from_result(res, count, rng)
+
+    def sample_edges_from_result(self, res, count: int, rng=None) -> np.ndarray:
+        rng = _rng(rng)
+        rowz = res.sample(count, rng)
+        out = np.full((count, 3), DEFAULT_ID, dtype=np.uint64)
+        ok = rowz >= 0
+        safe = np.maximum(rowz, 0)
+        for j, col in enumerate(
+            (self.edge_src, self.edge_dst, self.edge_types.astype(np.uint64))
+        ):
+            out[ok, j] = col[safe][ok]
+        return out
+
+    def condition_mask(self, ids, dnf, node: bool = True) -> np.ndarray:
+        """Bool mask: does each id satisfy the DNF condition?"""
+        rows = (
+            self.lookup(np.asarray(ids, dtype=np.uint64))
+            if node
+            else self._edge_rows(ids)
+        )
+        return self.search_condition(dnf, node=node).contains(rows)
+
+    def get_node_ids_by_condition(self, dnf) -> np.ndarray:
+        res = self.search_condition(dnf)
+        return np.asarray(self.node_ids[res.rows], dtype=np.uint64)
+
     # ---- graph-label path (whole-graph batches) ------------------------
 
     def get_graph_by_label(self, label_ids: np.ndarray) -> list[np.ndarray]:
@@ -729,6 +812,97 @@ class Graph:
 
     def node_type(self, ids) -> np.ndarray:
         return self._scatter_gather(ids, lambda sh, i: sh.node_type(i))
+
+    # -- conditioned sampling / filters (index subsystem, euler/core/index) --
+
+    def sample_node_with_condition(
+        self, count: int, dnf, node_type: int = -1, rng=None
+    ) -> np.ndarray:
+        """Sample nodes matching a DNF condition, weighted across shards by
+        each shard's matched weight (index-aware root sampling)."""
+        rng = _rng(rng)
+        if isinstance(node_type, str):
+            node_type = self.meta.node_type_id(node_type)
+        dnf = _fold_type(dnf, node_type)
+        if self.num_shards == 1:
+            return self.shards[0].sample_node_with_condition(count, dnf, -1, rng)
+        # one DNF search per shard, reused for both the shard-weight draw and
+        # the within-shard sample
+        results = [sh.search_condition(dnf) for sh in self.shards]
+        w = np.asarray([r.total_weight for r in results])
+        if w.sum() <= 0:
+            return np.full(count, DEFAULT_ID, dtype=np.uint64)
+        picks = _WeightedSampler(w).sample(count, rng)
+        out = np.full(count, DEFAULT_ID, dtype=np.uint64)
+        for s in range(self.num_shards):
+            sel = picks == s
+            if sel.any():
+                out[sel] = self.shards[s].sample_from_result(
+                    results[s], int(sel.sum()), rng
+                )
+        return out
+
+    def sample_edge_with_condition(
+        self, count: int, dnf, edge_type: int = -1, rng=None
+    ) -> np.ndarray:
+        """Exact-count conditioned edge sampling across shards → [count, 3]."""
+        rng = _rng(rng)
+        if isinstance(edge_type, str):
+            edge_type = self.meta.edge_type_id(edge_type)
+        dnf = _fold_type(dnf, edge_type)
+        if self.num_shards == 1:
+            return self.shards[0].sample_edge_with_condition(count, dnf, -1, rng)
+        results = [sh.search_condition(dnf, node=False) for sh in self.shards]
+        w = np.asarray([r.total_weight for r in results])
+        if w.sum() <= 0:
+            return np.full((count, 3), DEFAULT_ID, dtype=np.uint64)
+        picks = _WeightedSampler(w).sample(count, rng)
+        out = np.full((count, 3), DEFAULT_ID, dtype=np.uint64)
+        for s in range(self.num_shards):
+            sel = picks == s
+            if sel.any():
+                out[sel] = self.shards[s].sample_edges_from_result(
+                    results[s], int(sel.sum()), rng
+                )
+        return out
+
+    def condition_mask(self, ids, dnf, node: bool = True) -> np.ndarray:
+        if not node:
+            ids = np.asarray(ids, dtype=np.uint64)
+            owner = (ids[:, 0] % np.uint64(self.num_shards)).astype(np.int64)
+            out = np.zeros(len(ids), dtype=bool)
+            for s in range(self.num_shards):
+                sel = owner == s
+                if sel.any():
+                    out[sel] = self.shards[s].condition_mask(
+                        ids[sel], dnf, node=False
+                    )
+            return out
+        return self._scatter_gather(
+            ids, lambda sh, i: sh.condition_mask(i, dnf)
+        )
+
+    def get_node_ids_by_condition(self, dnf) -> np.ndarray:
+        parts = [sh.get_node_ids_by_condition(dnf) for sh in self.shards]
+        return np.sort(np.concatenate(parts)) if parts else np.empty(0, np.uint64)
+
+    def get_nb_filter(
+        self, ids, dnf, edge_types=None, max_degree=None, in_edges=False
+    ):
+        """Full neighbors with non-matching neighbors masked out
+        (API_GET_NB_FILTER parity, euler/core/kernels)."""
+        nbr, w, tt, mask, eidx = self.get_full_neighbor(
+            ids, edge_types, max_degree, in_edges
+        )
+        keep = self.condition_mask(nbr.reshape(-1), dnf).reshape(nbr.shape)
+        keep &= mask
+        return (
+            np.where(keep, nbr, DEFAULT_ID),
+            np.where(keep, w, 0.0).astype(np.float32),
+            np.where(keep, tt, -1),
+            keep,
+            np.where(keep, eidx, -1),
+        )
 
     def sample_neighbor(self, ids, edge_types=None, count=10, rng=None, in_edges=False):
         rng = _rng(rng)

@@ -5,13 +5,22 @@ kernels (euler/parser/gremlin.l:15-56, gremlin.y, compiler.h:35-196). Every
 tf_euler kernel actually emits a fixed template like
 `v(nodes).sampleNB(et0,et1,n).as(nb)` (sample_fanout_op.cc:36-49), so the
 TPU build compiles the same surface straight to the vectorized batch API —
-the scatter/REMOTE/merge machinery already lives in the Graph facade.
+the scatter/REMOTE/merge machinery already lives in the Graph facade, and
+`has*` conditions push down into the index subsystem
+(euler_tpu/graph/index.py) exactly where the reference's compiler pushes
+index_info (compiler.h:37-41).
 
-Supported steps (token names follow gremlin.l):
+Supported steps (token names follow gremlin.l:15-56):
   sources:  v(ids|param) | e(param) | sampleN(type, n) | sampleE(type, n)
+            | sampleNWithTypes([t...], n)
   traverse: sampleNB(t..., n) | sampleLNB(t..., n) | outV(t...) | inV(t...)
-  fetch:    values(f...) | label() | get()
-  filter:   has_type(t) | limit(n) | order_by(id|weight[, desc])
+            | outE(t...)
+  fetch:    values(f | udf_mean(f) | udf_min(f) | udf_max(f), ...) | label()
+            | get()
+  filter:   has(f, v) | has(f, gt(v)|ge|lt|le|eq|ne|in_([..])|not_in([..]))
+            | hasKey(f) | hasLabel(t) | or_()      [conditions attach to the
+            preceding source/traverse step; or_() starts a new DNF clause]
+            | has_type(t) | limit(n) | order_by(id|weight[, desc])
   name:     as(alias)
 
 `Query(gql).run(graph, inputs)` returns {alias: result}. Neighbor aliases
@@ -30,6 +39,15 @@ _TOKEN = re.compile(
     r"\s*(?:(?P<name>[A-Za-z_][A-Za-z0-9_]*)|(?P<num>-?\d+(?:\.\d+)?)"
     r"|(?P<str>'[^']*'|\"[^\"]*\")|(?P<punct>[().,\[\]]))"
 )
+
+_COND_STEPS = ("has", "hasKey", "hasLabel", "or_")
+_SOURCE_OR_TRAVERSE = (
+    "v", "e", "sampleN", "sampleE", "sampleNWithTypes",
+    "sampleNB", "sampleLNB", "outV", "inV", "outE",
+)
+_CMP_OPS = ("gt", "ge", "lt", "le", "eq", "ne", "in_", "not_in")
+_UDFS = {"udf_mean": np.mean, "udf_min": np.min, "udf_max": np.max,
+         "udf_sum": np.sum}
 
 
 def _tokenize(src: str):
@@ -54,7 +72,8 @@ def _tokenize(src: str):
 
 
 def _parse(src: str) -> list[tuple[str, list]]:
-    """'.'-chained calls → [(fn_name, args), ...]."""
+    """'.'-chained calls → [(fn_name, args), ...]. Args may be literals,
+    [lists], or one-level nested calls like gt(3) / udf_mean(f)."""
     toks = _tokenize(src)
     i = 0
     calls = []
@@ -69,43 +88,96 @@ def _parse(src: str) -> list[tuple[str, list]]:
         i += 1
         return toks[i - 1][1]
 
+    def parse_list():
+        nonlocal i
+        i += 1  # consume '['
+        lst = []
+        while toks[i] != ("punct", "]"):
+            if toks[i][0] in ("num", "str"):
+                lst.append(toks[i][1])
+            elif toks[i] == ("punct", ","):
+                pass
+            else:
+                raise SyntaxError(
+                    f"unexpected {toks[i][1]!r} inside [...] (literals only)"
+                )
+            i += 1
+        i += 1
+        return lst
+
+    def parse_args():
+        nonlocal i
+        args = []
+        expect("punct", "(")
+        while toks[i] != ("punct", ")"):
+            kind, val = toks[i]
+            if kind == "name" and i + 1 < len(toks) and toks[i + 1] == (
+                "punct", "("
+            ):
+                i += 1
+                args.append(("()", val, parse_args()))
+            elif kind in ("num", "str", "name"):
+                args.append(val)
+                i += 1
+            elif (kind, val) == ("punct", "["):
+                args.append(parse_list())
+            else:
+                raise SyntaxError(f"unexpected {val!r} in argument list")
+            if i < len(toks) and toks[i] == ("punct", ","):
+                i += 1
+        expect("punct", ")")
+        return args
+
     try:
         while i < len(toks):
             fn = expect("name")
-            args = []
-            expect("punct", "(")
-            while toks[i] != ("punct", ")"):
-                kind, val = toks[i]
-                if kind in ("num", "str", "name"):
-                    args.append(val)
-                    i += 1
-                elif (kind, val) == ("punct", "["):
-                    i += 1
-                    lst = []
-                    while toks[i] != ("punct", "]"):
-                        if toks[i][0] in ("num", "str"):
-                            lst.append(toks[i][1])
-                        elif toks[i] == ("punct", ","):
-                            pass
-                        else:
-                            raise SyntaxError(
-                                f"unexpected {toks[i][1]!r} inside [...] "
-                                "(only literals allowed)"
-                            )
-                        i += 1
-                    i += 1
-                    args.append(lst)
-                else:
-                    raise SyntaxError(f"unexpected {val!r} in argument list")
-                if i < len(toks) and toks[i] == ("punct", ","):
-                    i += 1
-            expect("punct", ")")
-            calls.append((fn, args))
+            calls.append((fn, parse_args()))
             if i < len(toks):
                 expect("punct", ".")
     except IndexError:
         raise SyntaxError("unexpected end of GQL input") from None
     return calls
+
+
+def _cond_atom(fn: str, args: list):
+    """A has/hasKey/hasLabel call → one DNF atom (field, op, value)."""
+    if fn == "hasKey":
+        return (str(args[0]), "haskey", None)
+    if fn == "hasLabel":
+        return ("type", "eq", args[0])
+    field = str(args[0])
+    if len(args) == 1:
+        return (field, "haskey", None)
+    v = args[1]
+    if isinstance(v, tuple) and v[0] == "()":
+        op = v[1]
+        if op not in _CMP_OPS:
+            raise SyntaxError(f"unknown comparison {op!r}")
+        inner = v[2][0] if len(v[2]) == 1 else list(v[2])
+        if op in ("in_", "not_in") and not isinstance(inner, list):
+            inner = [inner]
+        return (field, "in" if op == "in_" else op, inner)
+    return (field, "eq", v)
+
+
+def _compile(calls):
+    """Fold has*/or_ steps into DNF conditions on the preceding step."""
+    steps = []
+    for fn, args in calls:
+        if fn in _COND_STEPS:
+            if not steps or steps[-1][0] not in _SOURCE_OR_TRAVERSE:
+                raise SyntaxError(f"{fn} must follow a source/traverse step")
+            conds = steps[-1][2]
+            if fn == "or_":
+                if conds and conds[-1]:
+                    conds.append([])
+            else:
+                if not conds:
+                    conds.append([])
+                conds[-1].append(_cond_atom(fn, args))
+        else:
+            steps.append((fn, args, []))
+    return steps
 
 
 class Query:
@@ -114,8 +186,8 @@ class Query:
 
     def __init__(self, gql: str):
         self.gql = gql
-        self.calls = _parse(gql)
-        if not self.calls:
+        self.steps = _compile(_parse(gql))
+        if not self.steps:
             raise SyntaxError("empty query")
 
     def run(self, graph, inputs: dict | None = None, rng=None) -> dict:
@@ -132,21 +204,67 @@ class Query:
                 return np.asarray(arg, dtype=np.uint64)
             return np.asarray([arg], dtype=np.uint64)
 
-        for fn, args in self.calls:
+        def resolve_dnf(conds):
+            """Resolve type names in hasLabel atoms against graph meta."""
+            out = []
+            for clause in conds:
+                c = []
+                for field, op, value in clause:
+                    if field == "type" and isinstance(value, str):
+                        value = graph.meta.node_type_id(value)
+                    c.append((field, op, value))
+                out.append(c)
+            return out
+
+        def filter_frontier(ids, conds):
+            keep = graph.condition_mask(ids, resolve_dnf(conds))
+            return np.where(keep, ids, DEFAULT_ID)
+
+        for fn, args, conds in self.steps:
             if fn == "v":
                 cur = resolve_ids(args[0])
+                if conds:
+                    cur = filter_frontier(cur, conds)
                 last = cur
             elif fn == "e":
                 edges = np.asarray(inputs[args[0]], dtype=np.uint64)
+                if conds:
+                    keep = graph.condition_mask(
+                        edges, resolve_dnf(conds), node=False
+                    )
+                    edges = edges[keep]
                 cur = edges[:, 1]  # frontier = dst
                 last = edges
             elif fn == "sampleN":
                 t, n = int(args[0]), int(args[1])
-                cur = graph.sample_node(n, t, rng=rng)
+                if conds:
+                    cur = graph.sample_node_with_condition(
+                        n, resolve_dnf(conds), node_type=t, rng=rng
+                    )
+                else:
+                    cur = graph.sample_node(n, t, rng=rng)
                 last = cur
+            elif fn == "sampleNWithTypes":
+                types, n = args[0], int(args[1])
+                types = types if isinstance(types, list) else [types]
+                per = [
+                    graph.sample_node_with_condition(
+                        n, resolve_dnf(conds), node_type=int(t), rng=rng
+                    )
+                    if conds
+                    else graph.sample_node(n, int(t), rng=rng)
+                    for t in types
+                ]
+                last = np.stack(per)  # [T, n]
+                cur = last.reshape(-1)
             elif fn == "sampleE":
                 t, n = int(args[0]), int(args[1])
-                last = graph.sample_edge(n, t, rng=rng)
+                if conds:  # exact-count index-conditioned edge sampling
+                    last = graph.sample_edge_with_condition(
+                        n, resolve_dnf(conds), edge_type=t, rng=rng
+                    )
+                else:
+                    last = graph.sample_edge(n, t, rng=rng)
                 cur = last[:, 1]
             elif fn in ("sampleNB", "outV", "inV", "sampleLNB"):
                 *types, n = args if fn in ("sampleNB", "sampleLNB") else (
@@ -157,22 +275,76 @@ class Query:
                     nbr, w, tt, mask, _ = graph.sample_neighbor(
                         cur, et, int(n), rng=rng
                     )
-                    last = (nbr, w, tt, mask)
-                    cur = nbr.reshape(-1)
                 elif fn == "sampleLNB":
                     layer, adj, lmask = graph.sample_neighbor_layerwise(
                         cur, et, int(n), rng=rng
                     )
+                    if conds:  # filter the shared layer candidate set
+                        keep = graph.condition_mask(layer, resolve_dnf(conds))
+                        layer = np.where(keep, layer, DEFAULT_ID)
+                        adj = np.where(keep[None, :], adj, 0.0)
+                        lmask = lmask & keep
                     last = (layer, adj, lmask)
                     cur = layer
+                    continue
                 else:
                     nbr, w, tt, mask, _ = graph.get_full_neighbor(
                         cur, et, in_edges=(fn == "inV")
                     )
-                    last = (nbr, w, tt, mask)
-                    cur = nbr.reshape(-1)
+                if conds:  # nb-filter semantics (API_GET_NB_FILTER)
+                    keep = graph.condition_mask(
+                        nbr.reshape(-1), resolve_dnf(conds)
+                    ).reshape(nbr.shape)
+                    keep &= mask
+                    nbr = np.where(keep, nbr, DEFAULT_ID)
+                    w = np.where(keep, w, 0.0).astype(np.float32)
+                    tt = np.where(keep, tt, -1)
+                    mask = keep
+                last = (nbr, w, tt, mask)
+                cur = nbr.reshape(-1)
+            elif fn == "outE":
+                et = [int(t) for t in args] if args else None
+                nbr, w, tt, mask, eidx = graph.get_full_neighbor(cur, et)
+                if conds:  # filter edges whose destination fails the DNF
+                    keep = graph.condition_mask(
+                        nbr.reshape(-1), resolve_dnf(conds)
+                    ).reshape(nbr.shape)
+                    mask = mask & keep
+                    nbr = np.where(mask, nbr, DEFAULT_ID)
+                    w = np.where(mask, w, 0.0).astype(np.float32)
+                src = np.broadcast_to(
+                    np.asarray(cur, dtype=np.uint64)[:, None], nbr.shape
+                )
+                triples = np.stack(
+                    [src, nbr, np.maximum(tt, 0).astype(np.uint64)], axis=-1
+                )  # [n, D, 3]
+                last = (triples, w, mask)
             elif fn == "values":
-                last = graph.get_dense_feature(cur, [str(a) for a in args])
+                # one batched fetch for every referenced feature, then
+                # splice/aggregate per-arg columns in order
+                names = [
+                    str(a[2][0]) if isinstance(a, tuple) else str(a)
+                    for a in args
+                ]
+                if names:
+                    widths = [
+                        graph.meta.feature_spec(nm).dim for nm in names
+                    ]
+                    flat = graph.get_dense_feature(cur, names)
+                    offs = np.r_[0, np.cumsum(widths)]
+                    cols = []
+                    for k, a in enumerate(args):
+                        block = flat[:, offs[k] : offs[k + 1]]
+                        if isinstance(a, tuple) and a[0] == "()":
+                            if a[1] not in _UDFS:
+                                raise ValueError(f"unknown UDF {a[1]!r}")
+                            block = _UDFS[a[1]](
+                                block, axis=1, keepdims=True
+                            ).astype(np.float32)
+                        cols.append(block)
+                    last = np.concatenate(cols, axis=1)
+                else:
+                    last = None
             elif fn == "label":
                 last = graph.node_type(cur)
             elif fn == "get":
@@ -183,10 +355,18 @@ class Query:
                 last = cur
             elif fn == "limit":
                 n = int(args[0])
-                if isinstance(last, tuple):
-                    # row-wise truncation of the previous step's result
+                if isinstance(last, tuple) and len(last) == 4:
+                    # row-wise truncation of a neighbor step's result
                     last = tuple(x[:n] for x in last)
                     cur = np.asarray(last[0]).reshape(-1)
+                elif isinstance(last, tuple) and len(last) == 3:
+                    # outE triples / layerwise: truncate source rows only;
+                    # the frontier (and layer candidate set) is unchanged
+                    triples, w, mask = last
+                    if triples.ndim == 3:  # outE
+                        last = (triples[:n], w[:n], mask[:n])
+                    else:
+                        raise ValueError("limit after sampleLNB is undefined")
                 else:
                     cur = cur[:n]
                     if isinstance(last, np.ndarray):

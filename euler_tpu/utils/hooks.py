@@ -26,6 +26,7 @@ class SyncExit:
 
     def wait_all(self, timeout: float = 600.0, poll: float = 0.5):
         deadline = time.time() + timeout
+        done = 0
         while time.time() < deadline:
             done = sum(
                 os.path.exists(os.path.join(self.path, f"done_{w}"))

@@ -112,3 +112,122 @@ def test_limit_truncates_rows(g, rng):
 def test_bad_list_token_raises():
     with pytest.raises(SyntaxError, match="inside"):
         Query("v([nodes]).get().as(x)")
+
+
+# ---- conditions / index pushdown (has*, gremlin.l:15-56) -------------------
+
+
+def test_has_eq_filters_frontier(g):
+    from euler_tpu.graph.store import DEFAULT_ID
+
+    res = run_gql(g, "v([1, 2, 3, 4]).has(blob, '2a').get().as(x)")
+    x = res["x"]
+    assert int(x[1]) == 2
+    assert all(int(v) == DEFAULT_ID for v in x[[0, 2, 3]])
+
+
+def test_has_condition_on_sample(g, rng):
+    res = run_gql(g, "sampleN(0, 60).has(dense2, lt(3)).as(n)", rng=rng)
+    assert set(np.unique(res["n"])) == {2}
+
+
+def test_has_or_clause(g, rng):
+    from euler_tpu.graph.store import DEFAULT_ID
+
+    res = run_gql(
+        g,
+        "v([1, 2, 3, 4, 5, 6]).has(dense2, lt(2)).or_()"
+        ".has(dense2, gt(5)).get().as(x)",
+    )
+    kept = {int(v) for v in res["x"] if int(v) != DEFAULT_ID}
+    assert kept == {1, 5, 6}
+
+
+def test_haskey_and_haslabel(g):
+    from euler_tpu.graph.store import DEFAULT_ID
+
+    res = run_gql(g, "v([1, 2, 3]).hasKey(sp).get().as(x)")
+    assert {int(v) for v in res["x"]} == {1, 2, 3}
+    res = run_gql(g, "v([1, 2, 3, 4]).hasLabel(1).get().as(x)")
+    kept = {int(v) for v in res["x"] if int(v) != DEFAULT_ID}
+    assert kept == {1, 3}
+
+
+def test_neighbor_condition_filter(g, rng):
+    res = run_gql(g, "v([1, 2, 3]).outV().hasLabel(1).as(nb)", rng=rng)
+    nbr, w, tt, mask = res["nb"]
+    assert all(int(v) % 2 == 1 for v in nbr[mask])
+    assert (w[~mask] == 0).all()
+
+
+def test_sample_n_with_types(g, rng):
+    res = run_gql(g, "sampleNWithTypes([0, 1], 5).as(n)", rng=rng)
+    assert res["n"].shape == (2, 5)
+    assert set(np.unique(res["n"][0])) <= {2, 4, 6}
+    assert set(np.unique(res["n"][1])) <= {1, 3, 5}
+
+
+def test_out_e_triples(g):
+    res = run_gql(g, "v([1]).outE(0).as(e)")
+    triples, w, mask = res["e"]
+    assert triples.shape[-1] == 3
+    src, dst, et = triples[0][mask[0]].T
+    assert set(src.tolist()) == {1}
+    assert set(dst.tolist()) == {2}  # node 1's only type-0 out-edge → 2
+
+
+def test_values_udf(g):
+    res = run_gql(
+        g, "v([1, 2]).values(udf_mean(dense3), udf_max(dense2)).as(f)"
+    )
+    np.testing.assert_allclose(
+        res["f"], [[1.4, 1.2], [2.4, 2.2]], rtol=1e-5
+    )
+
+
+def test_in_list_condition(g):
+    from euler_tpu.graph.store import DEFAULT_ID
+
+    res = run_gql(g, "v([1, 2, 3, 4]).has(blob, in_(['1a', '3a'])).get().as(x)")
+    kept = {int(v) for v in res["x"] if int(v) != DEFAULT_ID}
+    assert kept == {1, 3}
+
+
+def test_limit_after_out_e_keeps_triples(g):
+    res = run_gql(g, "v([1, 2, 3]).outE().limit(2).as(e)")
+    triples, w, mask = res["e"]
+    assert triples.shape[0] == 2 and triples.shape[-1] == 3
+
+
+def test_layerwise_condition_filters_layer(g, rng):
+    from euler_tpu.graph.store import DEFAULT_ID
+
+    res = run_gql(g, "v([1, 2, 3]).sampleLNB(0, 1, 6).hasLabel(0).as(l)", rng=rng)
+    layer, adj, lmask = res["l"]
+    kept = layer[lmask]
+    assert all(int(v) % 2 == 0 for v in kept)
+    assert (adj[:, ~lmask] == 0).all()
+
+
+def test_out_e_condition_filters_dst(g):
+    res_all = run_gql(g, "v([1, 2, 3]).outE().as(e)")
+    res = run_gql(g, "v([1, 2, 3]).outE().hasLabel(1).as(e)")
+    triples, w, mask = res["e"]
+    assert mask.sum() < res_all["e"][2].sum()
+    assert all(int(d) % 2 == 1 for d in triples[..., 1][mask])
+
+
+def test_sample_e_condition_exact_count(g, rng):
+    res = run_gql(g, "sampleE(0, 16).has(e_dense, gt(3)).as(e)", rng=rng)
+    e = res["e"]
+    assert e.shape == (16, 3)
+    vals = g.get_edge_dense_feature(e, ["e_dense"])[:, 0]
+    assert (vals > 3).all()
+
+
+def test_in_scalar_wraps(g):
+    from euler_tpu.graph.store import DEFAULT_ID
+
+    res = run_gql(g, "v([1, 2, 3]).has(blob, in_('1a')).get().as(x)")
+    kept = {int(v) for v in res["x"] if int(v) != DEFAULT_ID}
+    assert kept == {1}

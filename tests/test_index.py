@@ -1,0 +1,183 @@
+"""Index subsystem: hash/range indexes, DNF algebra, conditioned sampling.
+
+Mirrors the reference's index tests (euler/core/index/*_test.cc) on the
+shared fixture-graph pattern (§4 of SURVEY.md)."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.graph import Graph
+from euler_tpu.graph.index import HashIndex, IndexResult, RangeIndex
+from euler_tpu.graph.store import DEFAULT_ID
+
+
+def _graph(num_partitions=1):
+    nodes = []
+    for i in range(40):
+        nodes.append(
+            {
+                "id": i + 1,
+                "type": i % 2,
+                "weight": 1.0 + (i % 4),
+                "features": [
+                    {"name": "price", "type": "dense", "value": [float(i)]},
+                    {
+                        "name": "tags",
+                        "type": "sparse",
+                        "value": [i % 3, 100 + i % 5] if i % 7 else [],
+                    },
+                    {
+                        "name": "city",
+                        "type": "binary",
+                        "value": "sfo" if i % 2 else "nyc",
+                    },
+                ],
+            }
+        )
+    edges = [
+        {
+            "src": i + 1,
+            "dst": (i % 40) + 1 if i != (i % 40) else ((i + 3) % 40) + 1,
+            "type": 0,
+            "weight": 1.0,
+            "features": [],
+        }
+        for i in range(1, 40)
+    ]
+    return Graph.from_json(
+        {"nodes": nodes, "edges": edges}, num_partitions=num_partitions
+    )
+
+
+def test_range_index_ops():
+    vals = np.array([5.0, 1.0, 3.0, 3.0, 9.0])
+    idx = RangeIndex.build(vals)
+    assert set(idx.search("lt", 3)) == {1}
+    assert set(idx.search("le", 3)) == {1, 2, 3}
+    assert set(idx.search("gt", 3)) == {0, 4}
+    assert set(idx.search("ge", 5)) == {0, 4}
+    assert set(idx.search("eq", 3)) == {2, 3}
+    assert set(idx.search("ne", 3)) == {0, 1, 4}
+    assert set(idx.search("in", [1, 9])) == {1, 4}
+    assert set(idx.search("not_in", [1, 9])) == {0, 2, 3}
+
+
+def test_hash_index_multivalued():
+    rows = np.array([0, 0, 1, 2, 2, 3])
+    vals = np.array([7, 8, 7, 9, 8, 7], dtype=np.uint64)
+    idx = HashIndex.build(rows, vals, num_rows=5)
+    assert set(idx.search("eq", 7)) == {0, 1, 3}
+    assert set(idx.search("in", [8, 9])) == {0, 2}
+    assert set(idx.search("haskey", None)) == {0, 1, 2, 3}
+    assert set(idx.search("ne", 7)) == {2, 4}  # complement incl. row 4
+
+
+def test_index_result_algebra():
+    w = np.ones(10, dtype=np.float32)
+    a = IndexResult(np.array([1, 3, 5, 7]), w)
+    b = IndexResult(np.array([3, 4, 5]), w)
+    assert list(a.intersect(b).rows) == [3, 5]
+    assert list(a.union(b).rows) == [1, 3, 4, 5, 7]
+    assert a.contains(np.array([3, 4, -1])).tolist() == [True, False, False]
+
+
+def test_dnf_search_and_ids():
+    g = _graph()
+    # price < 5 OR price >= 38  → ids 1..5 ∪ 39,40
+    ids = g.get_node_ids_by_condition(
+        [[("price", "lt", 5)], [("price", "ge", 38)]]
+    )
+    assert set(int(i) for i in ids) == set(range(1, 6)) | {39, 40}
+    # AND within a clause: price < 10 AND type == 1 → even i → ids 2,4,6,8,10
+    ids = g.get_node_ids_by_condition(
+        [[("price", "lt", 10), ("type", "eq", 1)]]
+    )
+    assert set(int(i) for i in ids) == {2, 4, 6, 8, 10}
+
+
+def test_haskey_and_binary_eq():
+    g = _graph()
+    no_tags = {7 * k + 1 for k in range(6)}  # i % 7 == 0 → empty tags
+    ids = g.get_node_ids_by_condition([[("tags", "haskey", None)]])
+    assert set(int(i) for i in ids) == set(range(1, 41)) - no_tags
+    ids = g.get_node_ids_by_condition([[("city", "eq", "nyc")]])
+    assert set(int(i) for i in ids) == {i for i in range(1, 41) if i % 2 == 1}
+
+
+def test_conditioned_sampling_distribution():
+    g = _graph()
+    rng = np.random.default_rng(0)
+    dnf = [[("price", "lt", 8)]]  # ids 1..8
+    out = g.sample_node_with_condition(4000, dnf, rng=rng)
+    assert set(int(i) for i in out) <= set(range(1, 9))
+    # weighted: node weight is 1 + (i-1)%4 → id 4 (w=4) ~4x id 1 (w=1)
+    counts = {i: int((out == i).sum()) for i in (1, 4)}
+    assert 2.5 < counts[4] / max(counts[1], 1) < 6.0
+
+
+def test_conditioned_sampling_with_type():
+    g = _graph()
+    rng = np.random.default_rng(1)
+    out = g.sample_node_with_condition(
+        200, [[("price", "ge", 20)]], node_type=0, rng=rng
+    )
+    assert set(int(i) for i in out) <= {i for i in range(21, 41) if (i - 1) % 2 == 0}
+
+
+def test_empty_condition_result():
+    g = _graph()
+    out = g.sample_node_with_condition(
+        5, [[("price", "gt", 1e9)]], rng=np.random.default_rng(0)
+    )
+    assert (out == DEFAULT_ID).all()
+
+
+def test_condition_mask_and_nb_filter():
+    g = _graph()
+    ids = np.arange(1, 11, dtype=np.uint64)
+    mask = g.condition_mask(ids, [[("price", "lt", 3)]])
+    assert mask.tolist() == [True, True, True] + [False] * 7
+    nbr, w, tt, keep, eidx = g.get_nb_filter(
+        np.array([2, 3], dtype=np.uint64), [[("city", "eq", "nyc")]]
+    )
+    flat = nbr[keep]
+    assert len(flat) > 0
+    assert all(int(x) % 2 == 1 for x in flat)  # nyc = odd ids
+    assert (w[~keep] == 0).all()
+
+
+@pytest.mark.parametrize("parts", [2, 3])
+def test_multishard_parity(parts):
+    g1, gp = _graph(1), _graph(parts)
+    dnf = [[("price", "lt", 9), ("type", "eq", 0)], [("tags", "eq", 101)]]
+    assert np.array_equal(
+        g1.get_node_ids_by_condition(dnf), gp.get_node_ids_by_condition(dnf)
+    )
+    ids = np.arange(1, 41, dtype=np.uint64)
+    assert np.array_equal(
+        g1.condition_mask(ids, dnf), gp.condition_mask(ids, dnf)
+    )
+    out = gp.sample_node_with_condition(
+        500, [[("price", "lt", 8)]], rng=np.random.default_rng(2)
+    )
+    assert set(int(i) for i in out) <= set(range(1, 9))
+
+
+def test_large_uint64_id_condition_exact():
+    base = np.uint64(1 << 60)
+    nodes = [
+        {"id": int(base + np.uint64(k)), "type": 0, "weight": 1.0, "features": []}
+        for k in range(4)
+    ]
+    g = Graph.from_json({"nodes": nodes, "edges": []})
+    # adjacent huge ids must not collide through a float64 cast
+    ids = g.get_node_ids_by_condition([[("id", "eq", int(base + np.uint64(2)))]])
+    assert [int(i) for i in ids] == [int(base + np.uint64(2))]
+    ids = g.get_node_ids_by_condition([[("id", "gt", int(base))]])
+    assert len(ids) == 3
+
+
+def test_negative_value_on_unsigned_column():
+    g = _graph()
+    assert len(g.get_node_ids_by_condition([[("id", "lt", -1)]])) == 0
+    assert len(g.get_node_ids_by_condition([[("id", "ge", -1)]])) == 40
