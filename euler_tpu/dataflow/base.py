@@ -68,16 +68,25 @@ class DataFlow:
         label_feature: str | None = None,
         label_dim: int | None = None,
         rng: np.random.Generator | None = None,
+        feature_mode: str = "dense",
     ):
         self.graph = graph
         self.feature_names = list(feature_names)
         self.label_feature = label_feature
         self.label_dim = label_dim
         self.rng = rng if rng is not None else np.random.default_rng()
+        if feature_mode not in ("dense", "rows"):
+            raise ValueError(f"unknown feature_mode {feature_mode!r}")
+        self.feature_mode = feature_mode
 
     # -- helpers ---------------------------------------------------------
 
     def node_feats(self, ids: np.ndarray) -> np.ndarray:
+        if self.feature_mode == "rows":
+            # ship int32 rows into a DeviceFeatureCache table instead of the
+            # dense payload; row 0 is the cache's zero/padding row
+            rows = self.graph.lookup_rows(ids)
+            return np.where(rows >= 0, rows + 1, 0).astype(np.int32)
         if not self.feature_names:
             return np.zeros((len(ids), 0), dtype=np.float32)
         return self.graph.get_dense_feature(ids, self.feature_names)
@@ -91,15 +100,48 @@ class DataFlow:
         raise NotImplementedError
 
 
-def fanout_block(batch: int, fanout: int, w: np.ndarray, mask: np.ndarray) -> Block:
-    """Block for sampled fanout: src j feeds dst j // fanout."""
+def fanout_block(
+    batch: int, fanout: int, w: np.ndarray, mask: np.ndarray, lazy: bool = False
+) -> Block:
+    """Block for sampled fanout: src j feeds dst j // fanout.
+
+    lazy=True skips materializing edge_src/edge_dst — they are a pure
+    function of (batch, fanout), so shipping them to the device every step
+    wastes host→device bandwidth; `hydrate_blocks` rebuilds them on device.
+    """
     e = batch * fanout
     return Block(
-        edge_src=np.arange(e, dtype=np.int32),
-        edge_dst=np.repeat(np.arange(batch, dtype=np.int32), fanout),
+        edge_src=None if lazy else np.arange(e, dtype=np.int32),
+        edge_dst=None if lazy else np.repeat(
+            np.arange(batch, dtype=np.int32), fanout
+        ),
         edge_w=w.reshape(-1).astype(np.float32),
         mask=mask.reshape(-1),
         n_src=e,
         n_dst=batch,
         grid=fanout,
     )
+
+
+def hydrate_blocks(batch: MiniBatch) -> MiniBatch:
+    """Rebuild lazy grid blocks' edge ids with on-device iota (jit-safe)."""
+    import jax.numpy as jnp
+
+    if not isinstance(batch, MiniBatch) or all(
+        b.edge_src is not None for b in batch.blocks
+    ):
+        return batch
+    blocks = []
+    for b in batch.blocks:
+        if b.edge_src is None:
+            blocks.append(
+                b.replace(
+                    edge_src=jnp.arange(b.n_src, dtype=jnp.int32),
+                    edge_dst=jnp.repeat(
+                        jnp.arange(b.n_dst, dtype=jnp.int32), b.grid
+                    ),
+                )
+            )
+        else:
+            blocks.append(b)
+    return batch.replace(blocks=tuple(blocks))

@@ -50,8 +50,11 @@ class LayerwiseDataFlow(DataFlow):
         label_dim=None,
         normalize: bool = True,
         rng=None,
+        feature_mode="dense",
     ):
-        super().__init__(graph, feature_names, label_feature, label_dim, rng)
+        super().__init__(
+            graph, feature_names, label_feature, label_dim, rng, feature_mode
+        )
         self.edge_types = edge_types
         self.layer_sizes = list(layer_sizes)
         self.normalize = normalize

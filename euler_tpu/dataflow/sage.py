@@ -20,10 +20,15 @@ class SageDataFlow(DataFlow):
         label_feature=None,
         label_dim=None,
         rng=None,
+        feature_mode="dense",
+        lazy_blocks: bool = False,
     ):
-        super().__init__(graph, feature_names, label_feature, label_dim, rng)
+        super().__init__(
+            graph, feature_names, label_feature, label_dim, rng, feature_mode
+        )
         self.edge_types = edge_types
         self.fanouts = list(fanouts)
+        self.lazy_blocks = lazy_blocks
 
     @property
     def num_hops(self) -> int:
@@ -40,7 +45,9 @@ class SageDataFlow(DataFlow):
             nbr, w, _, mask, _ = self.graph.sample_neighbor(
                 cur, self.edge_types, k, rng=self.rng
             )
-            blocks.append(fanout_block(len(cur), k, w, mask))
+            blocks.append(
+                fanout_block(len(cur), k, w, mask, lazy=self.lazy_blocks)
+            )
             cur = nbr.reshape(-1)
             hop_ids.append(cur)
             hop_masks.append(mask.reshape(-1))
@@ -75,8 +82,11 @@ class FullNeighborDataFlow(DataFlow):
         label_feature=None,
         label_dim=None,
         rng=None,
+        feature_mode="dense",
     ):
-        super().__init__(graph, feature_names, label_feature, label_dim, rng)
+        super().__init__(
+            graph, feature_names, label_feature, label_dim, rng, feature_mode
+        )
         self.edge_types = edge_types
         self.num_hops = num_hops
         self.max_degree = max_degree

@@ -345,6 +345,153 @@ class KGDataset(Dataset):
         return {"nodes": nodes, "edges": edges}
 
 
+class MovieLensDataset(Dataset):
+    """ml_1m bipartite user↔movie ratings graph (dataset/ml_1m.py parity).
+
+    Node ids: movies keep their MovieLens id (1..3952); users are offset by
+    3952. Movie nodes (type 0) carry a sparse `genre` feature; user nodes
+    (type 1) carry sparse `gender`/`age`/`occupation` and binary `zip_code`;
+    `rate` edges (type 0, user→movie) carry sparse `rating` and binary
+    `timestamp`.
+    """
+
+    GENRES = [
+        "Action", "Adventure", "Animation", "Children's", "Comedy", "Crime",
+        "Documentary", "Drama", "Fantasy", "Film-Noir", "Horror", "Musical",
+        "Mystery", "Romance", "Sci-Fi", "Thriller", "War", "Western",
+    ]
+    AGES = ["1", "18", "25", "35", "45", "50", "56"]
+    MOVIE_LEN = 3952
+
+    def __init__(self, name: str = "ml_1m", **kw):
+        self.name = name
+        self.feature_dim = len(self.GENRES)
+        self.num_classes = 5
+        super().__init__(**kw)
+
+    def raw_files(self):
+        return ["movies.dat", "ratings.dat", "users.dat"]
+
+    def _rows(self, fname: str):
+        path = os.path.join(self.root, fname)
+        with open(path, encoding="latin1") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line.split("::")
+
+    def build_json(self) -> dict:
+        genre_id = {g: i for i, g in enumerate(self.GENRES)}
+        age_id = {a: i for i, a in enumerate(self.AGES)}
+        nodes = []
+        for mid, _title, genres in self._rows("movies.dat"):
+            nodes.append(
+                {
+                    "id": int(mid),
+                    "type": 0,
+                    "weight": 1.0,
+                    "features": [
+                        {
+                            "name": "genre",
+                            "type": "sparse",
+                            "value": [genre_id[g] for g in genres.split("|")],
+                        }
+                    ],
+                }
+            )
+        for uid, gender, age, occupation, zip_code in self._rows("users.dat"):
+            nodes.append(
+                {
+                    "id": int(uid) + self.MOVIE_LEN,
+                    "type": 1,
+                    "weight": 1.0,
+                    "features": [
+                        {"name": "gender", "type": "sparse",
+                         "value": [0 if gender == "M" else 1]},
+                        {"name": "age", "type": "sparse",
+                         "value": [age_id[age]]},
+                        {"name": "occupation", "type": "sparse",
+                         "value": [int(occupation)]},
+                        {"name": "zip_code", "type": "binary",
+                         "value": str(zip_code)},
+                    ],
+                }
+            )
+        edges = [
+            {
+                "src": int(uid) + self.MOVIE_LEN,
+                "dst": int(mid),
+                "type": 0,
+                "weight": float(rating),
+                "features": [
+                    {"name": "rating", "type": "sparse", "value": [int(rating)]},
+                    {"name": "timestamp", "type": "binary", "value": str(ts)},
+                ],
+            }
+            for uid, mid, rating, ts in self._rows("ratings.dat")
+        ]
+        return {"nodes": nodes, "edges": edges}
+
+    def synthetic_json(self, seed: int = 0) -> dict:
+        rng = np.random.default_rng(seed)
+        n_movies, n_users, n_ratings = 120, 80, 1500
+        nodes = [
+            {
+                "id": m + 1,
+                "type": 0,
+                "weight": 1.0,
+                "features": [
+                    {
+                        "name": "genre",
+                        "type": "sparse",
+                        "value": sorted(
+                            rng.choice(
+                                len(self.GENRES),
+                                size=int(rng.integers(1, 4)),
+                                replace=False,
+                            ).tolist()
+                        ),
+                    }
+                ],
+            }
+            for m in range(n_movies)
+        ]
+        nodes += [
+            {
+                "id": self.MOVIE_LEN + u + 1,
+                "type": 1,
+                "weight": 1.0,
+                "features": [
+                    {"name": "gender", "type": "sparse",
+                     "value": [int(rng.integers(0, 2))]},
+                    {"name": "age", "type": "sparse",
+                     "value": [int(rng.integers(0, len(self.AGES)))]},
+                    {"name": "occupation", "type": "sparse",
+                     "value": [int(rng.integers(0, 21))]},
+                    {"name": "zip_code", "type": "binary",
+                     "value": f"{rng.integers(10000, 99999)}"},
+                ],
+            }
+            for u in range(n_users)
+        ]
+        edges = [
+            {
+                "src": self.MOVIE_LEN + int(rng.integers(1, n_users + 1)),
+                "dst": int(rng.integers(1, n_movies + 1)),
+                "type": 0,
+                "weight": float(rng.integers(1, 6)),
+                "features": [
+                    {"name": "rating", "type": "sparse",
+                     "value": [int(rng.integers(1, 6))]},
+                    {"name": "timestamp", "type": "binary",
+                     "value": f"{rng.integers(9e8, 1e9)}"},
+                ],
+            }
+            for _ in range(n_ratings)
+        ]
+        return {"nodes": nodes, "edges": edges}
+
+
 DATASETS = {
     "cora": lambda **kw: PlanetoidDataset("cora", **kw),
     "citeseer": lambda **kw: PlanetoidDataset("citeseer", **kw),
@@ -355,6 +502,7 @@ DATASETS = {
     "fb15k": lambda **kw: KGDataset("fb15k", **kw),
     "fb15k237": lambda **kw: KGDataset("fb15k237", **kw),
     "wn18": lambda **kw: KGDataset("wn18", **kw),
+    "ml_1m": lambda **kw: MovieLensDataset("ml_1m", **kw),
 }
 
 

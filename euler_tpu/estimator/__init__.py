@@ -7,3 +7,4 @@ from euler_tpu.estimator.estimator import (  # noqa: F401
     node_batches,
     unsupervised_batches,
 )
+from euler_tpu.estimator.feature_cache import DeviceFeatureCache  # noqa: F401

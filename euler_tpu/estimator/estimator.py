@@ -67,11 +67,15 @@ class Estimator:
         batch_fn: Callable[[], tuple],
         cfg: EstimatorConfig | None = None,
         mesh=None,
+        feature_cache=None,
     ):
         self.model = model
         self.batch_fn = batch_fn
         self.cfg = cfg or EstimatorConfig()
         self.mesh = mesh  # jax.sharding.Mesh → data-parallel + sharded tables
+        # DeviceFeatureCache: batches arrive as int32 feature rows and are
+        # hydrated to dense features on device, inside the jitted step
+        self.feature_cache = feature_cache
         self.params = None
         self.opt_state = None
         self.step = 0
@@ -92,12 +96,25 @@ class Estimator:
 
         return shard_batch(batch, self.mesh)
 
+    def _hydrate(self, batch: tuple) -> tuple:
+        from euler_tpu.dataflow.base import MiniBatch, hydrate_blocks
+
+        batch = tuple(
+            hydrate_blocks(b) if isinstance(b, MiniBatch) else b
+            for b in batch
+        )
+        return (
+            self.feature_cache.hydrate_args(batch)
+            if self.feature_cache is not None
+            else batch
+        )
+
     def _ensure_init(self):
         if self.params is not None:
             return
         import flax.linen as nn
 
-        batch = self._put(self.batch_fn())
+        batch = self._hydrate(self._put(self.batch_fn()))
         key = jax.random.PRNGKey(self.cfg.seed)
         keys = jax.random.split(key, 1 + len(self._rng_names))
         rngs = {"params": keys[0]}
@@ -123,6 +140,8 @@ class Estimator:
 
             @jax.jit
             def train_step(params, opt_state, rngs, *batch):
+                batch = self._hydrate(batch)
+
                 def loss_fn(p):
                     _, loss, _, metric = self.model.apply(
                         p, *batch, rngs=rngs
@@ -176,7 +195,9 @@ class Estimator:
                     f"step {self.step}: loss={loss_v:.4f} "
                     f"metric={float(metric):.4f} ({self.step / dt:.1f} it/s)"
                 )
-            history.append(float(loss))
+            # keep losses on device — a float() here would force a blocking
+            # device→host round trip every step and serialize the pipeline
+            history.append(loss)
             if (
                 self.cfg.checkpoint_steps
                 and self.step % self.cfg.checkpoint_steps == 0
@@ -187,13 +208,16 @@ class Estimator:
             jax.profiler.stop_trace()
         if save:
             self.save()
-        return history
+        # single batched fetch of all step losses (one transfer, not N)
+        return np.asarray(jnp.stack(history)).tolist() if history else []
 
     def evaluate(self, batches: Iterable[tuple]) -> dict:
         self._ensure_init()
         if self._jit_eval is None:
             self._jit_eval = jax.jit(
-                lambda p, rngs, *b: self.model.apply(p, *b, rngs=rngs)[1:4:2]
+                lambda p, rngs, *b: self.model.apply(
+                    p, *self._hydrate(b), rngs=rngs
+                )[1:4:2]
             )  # (loss, metric)
         name = None
         losses, metrics = [], []
@@ -202,7 +226,7 @@ class Estimator:
             loss, metric = self._jit_eval(self.params, self._rngs(0), *batch)
             if name is None:
                 name = self.model.apply(
-                    self.params, *batch, rngs=self._rngs(0)
+                    self.params, *self._hydrate(batch), rngs=self._rngs(0)
                 )[2]
             losses.append(float(loss))
             metrics.append(float(metric))
@@ -218,7 +242,9 @@ class Estimator:
         self._ensure_init()
         if self._jit_embed is None:
             self._jit_embed = jax.jit(
-                lambda p, b: self.model.apply(p, b, method=self.model.embed)
+                lambda p, b: self.model.apply(
+                    p, *self._hydrate((b,)), method=self.model.embed
+                )
             )
         embs, all_ids = [], []
         for batch, chunk_ids in zip(batches, ids):

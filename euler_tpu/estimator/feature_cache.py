@@ -1,0 +1,73 @@
+"""HBM-resident node feature cache.
+
+The reference fetches dense features from the graph engine per batch and
+ships them through the TF op boundary (feature_ops.py, get_dense_feature
+kernels). On TPU the equivalent boundary — host→device transfer — is the
+throughput ceiling: a 2-hop fanout batch carries ~B·k1·k2·F floats. The
+TPU-native answer is to load the dense feature table into device HBM once
+and ship only int32 row indices per batch; the gather runs on device inside
+the jitted step, where XLA fuses it with the first layer's matmul.
+
+Pair with DataFlow(feature_mode="rows"): hop feature slots then hold int32
+rows into this cache's table (row 0 = zero/padding row), and
+`hydrate(batch)` — called inside jit by the Estimator — turns them back
+into dense per-hop matrices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from euler_tpu.dataflow.base import MiniBatch
+
+
+def _is_rows(x) -> bool:
+    return getattr(x, "ndim", None) == 1 and jnp.issubdtype(
+        jnp.asarray(x).dtype, jnp.integer
+    )
+
+
+class DeviceFeatureCache:
+    """Device copy of a graph's dense feature table, +1 zero padding row."""
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        dtype=jnp.float32,
+        sharding=None,
+    ):
+        host = graph.dense_feature_table(list(feature_names))
+        self.dim = host.shape[1]
+        table = np.concatenate(
+            [np.zeros((1, self.dim), np.float32), host], axis=0
+        )
+        table = table.astype(np.dtype(dtype))
+        self.table = (
+            jax.device_put(table, sharding)
+            if sharding is not None
+            else jax.device_put(table)
+        )
+
+    def gather(self, rows) -> jnp.ndarray:
+        """int32 rows (0 = padding) → dense [n, F]; jit-safe."""
+        return self.table[rows]
+
+    def hydrate(self, batch):
+        """MiniBatch with rows-mode feature slots → dense feature slots.
+
+        Non-MiniBatch args and already-dense batches pass through, so the
+        Estimator can apply this uniformly to every model argument.
+        """
+        if not isinstance(batch, MiniBatch) or not batch.feats:
+            return batch
+        if not _is_rows(batch.feats[0]):
+            return batch
+        return batch.replace(
+            feats=tuple(self.gather(r) for r in batch.feats)
+        )
+
+    def hydrate_args(self, args: tuple) -> tuple:
+        return tuple(self.hydrate(a) for a in args)

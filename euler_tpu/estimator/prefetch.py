@@ -13,12 +13,22 @@ from typing import Callable
 
 
 class Prefetcher:
-    """Wraps batch_fn() in N producer threads + a bounded queue."""
+    """Wraps batch_fn() in N producer threads + a bounded queue.
+
+    With device_put=True, workers also stage each batch onto the device, so
+    host→device transfers overlap the previous step's compute instead of
+    serializing with it in the training loop.
+    """
 
     def __init__(
-        self, batch_fn: Callable[[], tuple], depth: int = 4, workers: int = 2
+        self,
+        batch_fn: Callable[[], tuple],
+        depth: int = 4,
+        workers: int = 2,
+        device_put: bool = False,
     ):
         self.batch_fn = batch_fn
+        self.device_put = device_put
         self.q: queue.Queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._threads = [
@@ -33,6 +43,10 @@ class Prefetcher:
         while not self._stop.is_set():
             try:
                 item = self.batch_fn()
+                if self.device_put:
+                    import jax
+
+                    item = jax.device_put(item)
             except Exception as e:  # surface producer errors to the consumer
                 self._error = e
                 self._stop.set()

@@ -41,7 +41,7 @@ CONV_MODELS = {
     "gated": "gated",
     "geniepath": "geniepath",
     "graph": "graph",
-    "lgcn": "gat",
+    "lgcn": "lgcn",
     "adaptivegcn": None,  # layerwise family
 }
 GRAPH_CLF = {"gin": ("gin", "mean"), "set2set": ("gin", "set2set"),

@@ -979,6 +979,40 @@ class Graph:
     def get_dense_feature(self, ids, names) -> np.ndarray:
         return self._scatter_gather(ids, lambda sh, i: sh.get_dense_feature(i, names))
 
+    def _shard_row_offsets(self) -> np.ndarray:
+        return np.cumsum([0] + [s.num_nodes for s in self.shards])
+
+    def lookup_rows(self, ids) -> np.ndarray:
+        """u64 ids → global dense rows (shard-major order); -1 for missing.
+
+        The row space enumerates every node across shards (shard 0's rows
+        first), letting device-resident feature tables replace per-batch
+        dense-feature transfers: ship int32 rows, gather [rows] on device.
+        """
+        offsets = self._shard_row_offsets()
+
+        def fn(shard, sub):
+            r = shard.lookup(sub)
+            return np.where(r >= 0, r + offsets[shard.part], -1)
+
+        return np.asarray(self._scatter_gather(ids, fn), dtype=np.int64)
+
+    def dense_feature_table(self, names) -> np.ndarray:
+        """f32 [total_nodes, F] dense features for all nodes, shard-major —
+        the host-side source for a device feature cache (rows from
+        lookup_rows index into it)."""
+        parts = [
+            sh._dense_by_rows(
+                np.arange(sh.num_nodes, dtype=np.int64), names, node=True
+            )
+            for sh in self.shards
+        ]
+        return (
+            np.concatenate(parts, axis=0)
+            if parts
+            else np.zeros((0, 0), np.float32)
+        )
+
     def get_sparse_feature(self, ids, names, max_len=None):
         if max_len is None:
             max_len = max(

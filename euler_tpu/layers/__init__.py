@@ -11,6 +11,7 @@ from euler_tpu.layers.conv import (  # noqa: F401
     GCNConv,
     GINConv,
     GraphConv,
+    LGCNConv,
     SAGEConv,
     SGCNConv,
     TAGConv,
@@ -31,6 +32,7 @@ CONVS = {
     "dna": DNAConv,
     "gated": GatedGraphConv,
     "geniepath": GeniePathConv,
+    "lgcn": LGCNConv,
 }
 
 

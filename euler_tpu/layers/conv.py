@@ -310,6 +310,38 @@ class RelationConv(Conv):
         return out
 
 
+class LGCNConv(Conv):
+    """Learnable graph conv (LGCN, encoders.py:872-922 parity): per-channel
+    top-k over each node's sampled neighbors, self feature prepended, then
+    two 1-D convolutions over the length-(k+1) sequence; the dst embedding
+    is the sequence's first position. Requires a grid block (fixed fanout),
+    which is how the reference feeds it (sample_neighbor(nb_num))."""
+
+    k: int = 3
+    hidden_dim: int = 128
+
+    @nn.compact
+    def __call__(self, x_dst, x_src, block: Block):
+        if not block.grid:
+            raise ValueError("LGCNConv needs a grid (fixed-fanout) block")
+        if block.grid < self.k:
+            raise ValueError(
+                f"LGCNConv k={self.k} needs fanout >= k, got {block.grid}"
+            )
+        d = block.grid
+        feat = x_src[block.edge_src.reshape(-1, d)]  # [n_dst, d, F]
+        # padded slots behave like default-feature (zero) neighbors, as the
+        # reference's default-id feature fetch does
+        feat = feat * block.mask.reshape(-1, d)[..., None].astype(feat.dtype)
+        topk = jax.lax.top_k(jnp.swapaxes(feat, 1, 2), self.k)[0]
+        topk = jnp.swapaxes(topk, 1, 2)  # [n_dst, k, F]
+        seq = jnp.concatenate([x_dst[:, None, :], topk], axis=1)
+        kernel = self.k // 2 + 1
+        h = nn.Conv(self.hidden_dim, (kernel,), padding="VALID")(seq)
+        h = nn.Conv(self.out_dim, (kernel,), padding="VALID")(h)
+        return h[:, 0, :]
+
+
 class GeniePathConv(Conv):
     """GeniePath lazy variant: GAT-style breadth attention + LSTM depth
     gate (geniepath parity)."""

@@ -1,0 +1,148 @@
+"""DeviceFeatureCache: rows-mode batches must train identically to dense."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.dataflow import SageDataFlow
+from euler_tpu.estimator import (
+    DeviceFeatureCache,
+    Estimator,
+    EstimatorConfig,
+    node_batches,
+)
+from euler_tpu.graph import Graph
+from euler_tpu.models import GraphSAGESupervised
+
+from test_training import make_cluster_graph
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return make_cluster_graph()
+
+
+def test_lookup_rows_roundtrip(graph):
+    ids = graph.sample_node(16, rng=np.random.default_rng(0))
+    rows = graph.lookup_rows(ids)
+    assert (rows >= 0).all()
+    table = graph.dense_feature_table(["feat"])
+    direct = graph.get_dense_feature(ids, ["feat"])
+    np.testing.assert_allclose(table[rows], direct)
+
+
+def test_lookup_rows_missing(graph):
+    rows = graph.lookup_rows(np.asarray([999999], dtype=np.uint64))
+    assert rows[0] == -1
+
+
+def test_lookup_rows_multishard():
+    g1 = make_cluster_graph()
+    nodes = [
+        {
+            "id": i + 1,
+            "type": 0,
+            "weight": 1.0,
+            "features": [
+                {"name": "feat", "type": "dense", "value": [float(i), 1.0]}
+            ],
+        }
+        for i in range(20)
+    ]
+    edges = [
+        {"src": i + 1, "dst": (i + 1) % 20 + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for i in range(20)
+    ]
+    g = Graph.from_json({"nodes": nodes, "edges": edges}, num_partitions=3)
+    assert g.num_shards == 3
+    ids = np.arange(1, 21, dtype=np.uint64)
+    rows = g.lookup_rows(ids)
+    assert sorted(rows.tolist()) == list(range(20))
+    table = g.dense_feature_table(["feat"])
+    np.testing.assert_allclose(table[rows][:, 0], np.arange(20, dtype=np.float32))
+    del g1
+
+
+def test_rows_mode_matches_dense(graph):
+    rng = np.random.default_rng(3)
+    dense_flow = SageDataFlow(
+        graph, ["feat"], fanouts=[3, 2], label_feature="label",
+        rng=np.random.default_rng(7),
+    )
+    rows_flow = SageDataFlow(
+        graph, ["feat"], fanouts=[3, 2], label_feature="label",
+        rng=np.random.default_rng(7), feature_mode="rows",
+    )
+    roots = graph.sample_node(8, rng=rng)
+    dense_b = dense_flow.query(roots)
+    rows_b = rows_flow.query(roots)
+    assert rows_b.feats[0].dtype == np.int32 and rows_b.feats[0].ndim == 1
+    cache = DeviceFeatureCache(graph, ["feat"])
+    hydrated = cache.hydrate(rows_b)
+    for d, h in zip(dense_b.feats, hydrated.feats):
+        np.testing.assert_allclose(np.asarray(h), d, atol=1e-6)
+    # dense batches pass through untouched
+    assert cache.hydrate(dense_b) is dense_b
+
+
+def test_lazy_blocks_hydrate(graph):
+    from euler_tpu.dataflow.base import hydrate_blocks
+
+    flow_dense = SageDataFlow(
+        graph, ["feat"], fanouts=[3, 2], rng=np.random.default_rng(5)
+    )
+    flow_lazy = SageDataFlow(
+        graph, ["feat"], fanouts=[3, 2], rng=np.random.default_rng(5),
+        lazy_blocks=True,
+    )
+    roots = graph.sample_node(6, rng=np.random.default_rng(2))
+    dense_b = flow_dense.query(roots)
+    lazy_b = flow_lazy.query(roots)
+    assert all(b.edge_src is None for b in lazy_b.blocks)
+    hydrated = hydrate_blocks(lazy_b)
+    for d, h in zip(dense_b.blocks, hydrated.blocks):
+        np.testing.assert_array_equal(np.asarray(h.edge_src), d.edge_src)
+        np.testing.assert_array_equal(np.asarray(h.edge_dst), d.edge_dst)
+    assert hydrate_blocks(dense_b) is dense_b
+
+
+def test_training_lazy_rows(graph, tmp_path):
+    """Full wire-efficient path: rows mode + lazy blocks + cache."""
+    rng = np.random.default_rng(1)
+    flow = SageDataFlow(
+        graph, ["feat"], fanouts=[3, 2], label_feature="label", rng=rng,
+        feature_mode="rows", lazy_blocks=True,
+    )
+    cache = DeviceFeatureCache(graph, ["feat"])
+    model = GraphSAGESupervised(dims=[16, 16], label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path), total_steps=20, learning_rate=0.05,
+        log_steps=1000,
+    )
+    est = Estimator(
+        model, node_batches(graph, flow, 16, rng=rng), cfg,
+        feature_cache=cache,
+    )
+    history = est.train(log=False)
+    assert np.isfinite(history).all()
+
+
+def test_training_with_cache(graph, tmp_path):
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        graph, ["feat"], fanouts=[3, 2], label_feature="label", rng=rng,
+        feature_mode="rows",
+    )
+    cache = DeviceFeatureCache(graph, ["feat"])
+    model = GraphSAGESupervised(dims=[16, 16], label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path), total_steps=30, learning_rate=0.05,
+        log_steps=1000,
+    )
+    est = Estimator(
+        model, node_batches(graph, flow, 16, rng=rng), cfg,
+        feature_cache=cache,
+    )
+    history = est.train(log=False)
+    assert np.isfinite(history).all()
+    assert history[-1] < history[0]
