@@ -5,6 +5,8 @@ from euler_tpu.estimator.estimator import (  # noqa: F401
     id_batches,
     make_optimizer,
     node_batches,
+    read_sample_ids,
+    sample_file_batches,
     unsupervised_batches,
 )
 from euler_tpu.estimator.feature_cache import DeviceFeatureCache  # noqa: F401

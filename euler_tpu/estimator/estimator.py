@@ -353,6 +353,46 @@ def unsupervised_batches(
     return fn
 
 
+def _padded_chunks(ids: np.ndarray, batch_size: int) -> Iterator[np.ndarray]:
+    """Fixed-size id chunks; the last one pads by repeating its final id."""
+    for i in range(0, len(ids), batch_size):
+        chunk = ids[i : i + batch_size]
+        if len(chunk) < batch_size:  # pad to keep shapes static
+            chunk = np.concatenate(
+                [chunk, np.repeat(chunk[-1:], batch_size - len(chunk))]
+            )
+        yield chunk
+
+
+def read_sample_ids(path: str, column: int = 0) -> np.ndarray:
+    """u64 root ids from a comma-separated sample file (one sample/line)."""
+    from euler_tpu.utils.file_io import open_file
+
+    with open_file(path, "r") as f:
+        rows = [line.strip().split(",") for line in f if line.strip()]
+    return np.asarray([np.uint64(r[column]) for r in rows], dtype=np.uint64)
+
+
+def sample_file_batches(
+    flow,
+    path: str,
+    batch_size: int,
+    epochs: int = 1,
+    column: int = 0,
+) -> Iterator[tuple]:
+    """Training source from comma-separated sample files
+    (SampleEstimator parity, euler_estimator sample_estimator.py): each
+    line holds CSV fields; `column` selects the root node id field. Yields
+    padded fixed-size batches for `epochs` passes. The final batch repeats
+    its last id to keep shapes static — for exact evaluation/inference over
+    a sample file, pass `read_sample_ids(path)` to `id_batches`, whose id
+    chunks identify the padding."""
+    ids = read_sample_ids(path, column)
+    for _ in range(epochs):
+        for chunk in _padded_chunks(ids, batch_size):
+            yield (flow.query(chunk),)
+
+
 def id_batches(
     flow, ids: np.ndarray, batch_size: int
 ) -> tuple[Iterator[tuple], Iterator[np.ndarray]]:
@@ -360,12 +400,7 @@ def id_batches(
     ids = np.asarray(ids, dtype=np.uint64)
 
     def batches():
-        for i in range(0, len(ids), batch_size):
-            chunk = ids[i : i + batch_size]
-            if len(chunk) < batch_size:  # pad to keep shapes static
-                chunk = np.concatenate(
-                    [chunk, np.repeat(chunk[-1:], batch_size - len(chunk))]
-                )
+        for chunk in _padded_chunks(ids, batch_size):
             yield (flow.query(chunk),)
 
     def id_chunks():

@@ -980,6 +980,11 @@ class Graph:
         return self._scatter_gather(ids, lambda sh, i: sh.get_dense_feature(i, names))
 
     def _shard_row_offsets(self) -> np.ndarray:
+        if not all(hasattr(s, "num_nodes") for s in self.shards):
+            raise RuntimeError(
+                "feature-cache row lookup needs local shards; remote graphs "
+                "fetch features per batch (get_dense_feature)"
+            )
         return np.cumsum([0] + [s.num_nodes for s in self.shards])
 
     def lookup_rows(self, ids) -> np.ndarray:

@@ -1,3 +1,10 @@
 from euler_tpu.nn import metrics  # noqa: F401
+from euler_tpu.nn.embedding import (  # noqa: F401
+    embedding_add,
+    embedding_moving_average,
+    embedding_update,
+    partitioned_lookup,
+    partitioned_update,
+)
 from euler_tpu.nn.base_gnn import GNNNet, JKGNNNet  # noqa: F401
 from euler_tpu.nn.heads import SuperviseModel, UnsuperviseModel  # noqa: F401

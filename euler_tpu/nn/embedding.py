@@ -1,0 +1,75 @@
+"""Partial updates of (partitioned) embedding tables.
+
+The reference scatters updates into PS-partitioned embedding variables with
+a mod partition strategy (tf_euler/python/utils/embedding.py:24-90:
+`embedding_update`/`embedding_add` over `PartitionedVariable`). The JAX
+equivalents are functional: `.at[rows]` scatters on a device table — under
+jit with donated buffers they update in place, and on a mesh-sharded table
+XLA routes the scatter through the owning shards. The mod-partitioned
+list-of-tables form is kept for host-offloaded tables too big for one HBM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def embedding_update(table, ids, values):
+    """rows[ids] = values (tf.scatter_update parity)."""
+    return table.at[ids].set(values)
+
+
+def embedding_add(table, ids, values):
+    """rows[ids] += values (tf.scatter_add parity)."""
+    return table.at[ids].add(values)
+
+
+def embedding_moving_average(table, ids, values, momentum: float):
+    """rows[ids] = m*rows[ids] + (1-m)*values (history-embedding refresh)."""
+    old = table[ids]
+    return table.at[ids].set(momentum * old + (1.0 - momentum) * values)
+
+
+def _mod_partition(ids, num_parts: int):
+    """mod strategy: part = id % P, local row = id // P."""
+    ids = jnp.asarray(ids)
+    return ids % num_parts, ids // num_parts
+
+
+def partitioned_lookup(tables: list, ids):
+    """Gather rows from mod-partitioned tables (embedding_lookup parity).
+
+    Each table p holds rows {id : id % P == p} at local row id // P. The
+    gather touches every partition with masked scatters so shapes stay
+    static under jit.
+    """
+    part, local = _mod_partition(ids, len(tables))
+    out = jnp.zeros(ids.shape + tables[0].shape[1:], tables[0].dtype)
+    for p, t in enumerate(tables):
+        sel = part == p
+        rows = jnp.where(sel, local, 0)
+        out = jnp.where(sel[..., None], t[rows], out)
+    return out
+
+
+def partitioned_update(tables: list, ids, values, func=embedding_update):
+    """Scatter `values` into mod-partitioned tables; returns new tables.
+
+    func is embedding_update or embedding_add (the reference's
+    tf.scatter_update / tf.scatter_add choice). Duplicate ids within one
+    call have undefined precedence (the reference's tf.scatter_update
+    shares that caveat).
+    """
+    part, local = _mod_partition(ids, len(tables))
+    out = []
+    for p, t in enumerate(tables):
+        sel = part == p
+        rows = jnp.where(sel, local, 0)
+        if func is embedding_add:
+            delta = jnp.where(sel[..., None], values, 0)
+        else:
+            # set as an add of (value - current): unselected ids collapse to
+            # row 0 with delta 0, so scatter collisions there are harmless
+            delta = jnp.where(sel[..., None], values - t[rows], 0)
+        out.append(t.at[rows].add(delta))
+    return out

@@ -18,6 +18,50 @@ import optax
 from euler_tpu.nn.metrics import METRICS
 
 
+class SampleNegWithTypes:
+    """Global negative sampler per root (solution/samplers.py parity):
+    num_negs nodes of each requested type, [B, num_negs] per type."""
+
+    def __init__(self, graph, neg_type, num_negs: int = 5, rng=None):
+        import numpy as np
+
+        self.graph = graph
+        self.neg_types = neg_type if isinstance(neg_type, list) else [neg_type]
+        self.num_negs = num_negs
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    def __call__(self, inputs):
+        b = len(inputs)
+        groups = [
+            self.graph.sample_node(
+                b * self.num_negs, t, rng=self.rng
+            ).reshape(b, self.num_negs)
+            for t in self.neg_types
+        ]
+        return groups[0] if len(groups) == 1 else groups
+
+
+class SamplePosWithTypes:
+    """Positive-context sampler (solution/samplers.py parity): num_pos
+    sampled neighbors over the given edge types, [B, num_pos]."""
+
+    def __init__(self, graph, edge_type, num_pos: int = 1, rng=None):
+        import numpy as np
+
+        self.graph = graph
+        self.edge_types = (
+            edge_type if isinstance(edge_type, list) else [edge_type]
+        )
+        self.num_pos = num_pos
+        self.rng = rng if rng is not None else np.random.default_rng()
+
+    def __call__(self, inputs):
+        nbr, _, _, _, _ = self.graph.sample_neighbor(
+            inputs, self.edge_types, self.num_pos, rng=self.rng
+        )
+        return nbr
+
+
 class DenseLogits(nn.Module):
     num_classes: int
 

@@ -1,1 +1,2 @@
 from euler_tpu.utils.hooks import SyncExit  # noqa: F401
+from euler_tpu.utils.file_io import exists, list_dir, open_file  # noqa: F401

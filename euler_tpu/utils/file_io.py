@@ -1,0 +1,80 @@
+"""Unified file IO over local FS and HDFS.
+
+The reference abstracts storage behind FileIO with local and libhdfs
+implementations (euler/common/file_io.h, local_file_io.cc, hdfs_file_io.cc)
+so graph data and sample files can live on either. Here the same seam is a
+path-scheme dispatch: `hdfs://` paths go through pyarrow's HadoopFileSystem
+when available (gated — this image has no HDFS), everything else through
+the local filesystem.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _is_hdfs(path: str) -> bool:
+    return path.startswith("hdfs://")
+
+
+def _hdfs_fs(path: str):
+    """(filesystem, fs-local path) for an hdfs://[host:port]/... URI.
+
+    The filesystem connects to the authority named in the path itself (or
+    fs.defaultFS when the path has none), so explicit namenode addresses
+    resolve against the right cluster.
+    """
+    try:
+        from pyarrow import fs as pafs
+
+        filesystem, p = pafs.FileSystem.from_uri(path)
+        return filesystem, p
+    except Exception as e:  # gated: no libhdfs/Hadoop in this image
+        raise RuntimeError(
+            "hdfs:// paths need pyarrow with libhdfs; install pyarrow and "
+            "set HADOOP_HOME/CLASSPATH, or copy the data to local disk"
+        ) from e
+
+
+def open_file(path: str, mode: str = "rb"):
+    """open() across local and hdfs:// paths (FileIO::NewFileIO parity).
+
+    HDFS supports read ("r"/"rb"), truncating write ("w"/"wb"), and append
+    ("a"/"ab"); update modes ("r+", "w+") are local-only.
+    """
+    if not _is_hdfs(path):
+        return open(path, mode)
+    if "+" in mode:
+        raise ValueError(f"update mode {mode!r} is not supported on hdfs://")
+    fs, p = _hdfs_fs(path)
+    if "r" in mode:
+        stream = fs.open_input_stream(p)
+    elif "a" in mode:
+        stream = fs.open_append_stream(p)
+    else:
+        stream = fs.open_output_stream(p)
+    if "b" not in mode:
+        import io
+
+        return io.TextIOWrapper(stream)
+    return stream
+
+
+def list_dir(path: str) -> list[str]:
+    """Directory entries (names only), local or hdfs://."""
+    if not _is_hdfs(path):
+        return sorted(os.listdir(path))
+    fs, p = _hdfs_fs(path)
+    from pyarrow import fs as pafs
+
+    infos = fs.get_file_info(pafs.FileSelector(p))
+    return sorted(os.path.basename(i.path) for i in infos)
+
+
+def exists(path: str) -> bool:
+    if not _is_hdfs(path):
+        return os.path.exists(path)
+    fs, p = _hdfs_fs(path)
+    from pyarrow import fs as pafs
+
+    return fs.get_file_info(p).type != pafs.FileType.NotFound

@@ -134,3 +134,33 @@ def test_server_error_reporting(cluster):
         remote.shards[0].call("no_such_op", [])
     with pytest.raises(RpcError, match="KeyError"):
         remote.shards[0].get_dense_feature(ALL_IDS, ["nope"])
+
+
+def test_remote_gql(cluster, rng):
+    """GQL chains execute against remote shards through the same facade
+    (the reference's distribute-mode compiled SPLIT→REMOTE→MERGE path)."""
+    from euler_tpu.query import run_gql
+
+    remote, local, *_ = cluster
+    res_r = run_gql(
+        remote, "v(roots).outV().as(nb)", {"roots": ALL_IDS},
+        rng=np.random.default_rng(0),
+    )
+    res_l = run_gql(
+        local, "v(roots).outV().as(nb)", {"roots": ALL_IDS},
+        rng=np.random.default_rng(0),
+    )
+    np.testing.assert_array_equal(res_r["nb"][0], res_l["nb"][0])
+    res = run_gql(
+        remote, "v(roots).values(dense2).as(f)", {"roots": ALL_IDS},
+        rng=np.random.default_rng(0),
+    )
+    np.testing.assert_allclose(
+        res["f"], local.get_dense_feature(ALL_IDS, ["dense2"])
+    )
+
+
+def test_remote_feature_cache_guard(cluster):
+    remote, *_ = cluster
+    with pytest.raises(RuntimeError, match="local shards"):
+        remote.lookup_rows(ALL_IDS)

@@ -1,0 +1,171 @@
+"""Parity extras: embedding partial updates, solution samplers,
+sample-file batches, file IO, ml_1m dataset, LGCN conv."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from test_training import make_cluster_graph
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return make_cluster_graph()
+
+
+# ---- embedding partial updates (utils/embedding.py parity) --------------
+
+
+def test_embedding_update_add():
+    from euler_tpu.nn import embedding_add, embedding_update
+
+    t = jnp.zeros((10, 4))
+    t = embedding_update(t, jnp.asarray([2, 5]), jnp.ones((2, 4)))
+    assert float(t[2].sum()) == 4.0 and float(t[5].sum()) == 4.0
+    t = embedding_add(t, jnp.asarray([2]), jnp.ones((1, 4)))
+    assert float(t[2].sum()) == 8.0
+
+
+def test_embedding_moving_average():
+    from euler_tpu.nn import embedding_moving_average
+
+    t = jnp.ones((4, 2))
+    t = embedding_moving_average(
+        t, jnp.asarray([1]), jnp.zeros((1, 2)), momentum=0.75
+    )
+    np.testing.assert_allclose(np.asarray(t[1]), [0.75, 0.75])
+
+
+def test_partitioned_lookup_update():
+    from euler_tpu.nn import (
+        embedding_add,
+        partitioned_lookup,
+        partitioned_update,
+    )
+
+    # mod partitioning: id i lives in table i % 3 at row i // 3
+    np_rng = np.random.default_rng(0)
+    full = np_rng.normal(size=(12, 4)).astype(np.float32)
+    tables = [jnp.asarray(full[p::3]) for p in range(3)]
+    ids = jnp.asarray([0, 4, 7, 11, 4])  # duplicate OK for lookup
+    out = partitioned_lookup(tables, ids)
+    np.testing.assert_allclose(np.asarray(out), full[np.asarray(ids)], rtol=1e-6)
+
+    ids = jnp.asarray([0, 4, 7, 11])  # update precedence undefined for dups
+    vals = jnp.ones((4, 4))
+    new = partitioned_update(tables, ids, vals)
+    got = partitioned_lookup(new, jnp.arange(12))
+    expect = full.copy()
+    expect[[0, 4, 7, 11]] = 1.0
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-6)
+
+    added = partitioned_update(tables, jnp.asarray([1, 2]), vals[:2],
+                               func=embedding_add)
+    got = partitioned_lookup(added, jnp.arange(12))
+    expect = full.copy()
+    expect[[1, 2]] += 1.0
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-6)
+
+
+def test_partitioned_update_jit():
+    from euler_tpu.nn import partitioned_lookup, partitioned_update
+
+    tables = [jnp.zeros((4, 2)) for _ in range(2)]
+
+    @jax.jit
+    def step(tables, ids, vals):
+        return partitioned_update(tables, ids, vals)
+
+    new = step(tables, jnp.asarray([0, 3]), jnp.ones((2, 2)))
+    got = partitioned_lookup(new, jnp.asarray([0, 3]))
+    np.testing.assert_allclose(np.asarray(got), 1.0)
+
+
+# ---- solution samplers --------------------------------------------------
+
+
+def test_solution_samplers(graph):
+    from euler_tpu.solution import SampleNegWithTypes, SamplePosWithTypes
+
+    rng = np.random.default_rng(0)
+    roots = graph.sample_node(8, rng=rng)
+    negs = SampleNegWithTypes(graph, 0, num_negs=3, rng=rng)(roots)
+    assert negs.shape == (8, 3)
+    pos = SamplePosWithTypes(graph, 0, num_pos=2, rng=rng)(roots)
+    assert pos.shape == (8, 2)
+    groups = SampleNegWithTypes(graph, [0, 0], num_negs=2, rng=rng)(roots)
+    assert isinstance(groups, list) and len(groups) == 2
+
+
+# ---- sample-file batches (SampleEstimator parity) -----------------------
+
+
+def test_sample_file_batches(graph, tmp_path):
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import sample_file_batches
+
+    path = tmp_path / "samples.txt"
+    ids = [1, 2, 3, 4, 5]
+    path.write_text("\n".join(f"{i},{i + 1},x" for i in ids))
+    flow = SageDataFlow(graph, ["feat"], fanouts=[2])
+    batches = list(sample_file_batches(flow, str(path), 2, epochs=2))
+    assert len(batches) == 6  # ceil(5/2)=3 per epoch × 2
+    assert batches[0][0].feats[0].shape[0] == 2
+    # column selection
+    batches = list(sample_file_batches(flow, str(path), 5, column=1))
+    roots = np.asarray(batches[0][0].root_idx)
+    np.testing.assert_array_equal(roots, [2, 3, 4, 5, 6])
+
+
+# ---- file IO ------------------------------------------------------------
+
+
+def test_file_io_local(tmp_path):
+    from euler_tpu.utils import exists, list_dir, open_file
+
+    p = tmp_path / "a.txt"
+    with open_file(str(p), "w") as f:
+        f.write("hello")
+    with open_file(str(p), "r") as f:
+        assert f.read() == "hello"
+    assert exists(str(p)) and not exists(str(tmp_path / "nope"))
+    assert "a.txt" in list_dir(str(tmp_path))
+
+
+def test_file_io_hdfs_gated():
+    from euler_tpu.utils import open_file
+
+    with pytest.raises(RuntimeError, match="pyarrow"):
+        open_file("hdfs://nn:9000/x", "rb")
+
+
+# ---- ml_1m dataset (synthetic offline stand-in) -------------------------
+
+
+def test_ml_1m_synthetic(tmp_path):
+    from euler_tpu.datasets import get_dataset
+    from euler_tpu.graph import Graph
+
+    ds = get_dataset("ml_1m", root=str(tmp_path))
+    g = Graph.from_json(ds.synthetic_json())
+    assert g.meta.num_node_types == 2
+    movies = g.sample_node(8, 0, rng=np.random.default_rng(0))
+    genres = g.get_sparse_feature(movies, ["genre"])
+    assert genres[0][0].shape[0] == 8
+    users = g.sample_node(4, 1, rng=np.random.default_rng(0))
+    assert (users > 3952).all()
+
+
+# ---- LGCN conv ----------------------------------------------------------
+
+
+def test_lgcn_fanout_guard(graph):
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.layers import LGCNConv
+
+    flow = SageDataFlow(graph, ["feat"], fanouts=[2])
+    mb = flow.query(np.asarray([1, 2], np.uint64))
+    layer = LGCNConv(out_dim=8, k=3)
+    with pytest.raises(ValueError, match="fanout"):
+        layer.init(jax.random.PRNGKey(0), mb.feats[0], mb.feats[1], mb.blocks[0])
