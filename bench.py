@@ -20,6 +20,7 @@ import time
 import numpy as np
 
 SMOKE = "--smoke" in sys.argv
+BF16 = "--bf16" in sys.argv
 BASELINE_EDGES_PER_SEC = 2_000_000.0
 
 
@@ -74,7 +75,12 @@ def main():
         graph, ["feat"], fanouts=fanouts, label_feature="label", rng=rng,
         feature_mode="rows", lazy_blocks=True,
     )
-    model = GraphSAGESupervised(dims=dims, label_dim=2)
+    conv_kwargs = None
+    if BF16:
+        import jax.numpy as jnp
+
+        conv_kwargs = {"dtype": jnp.bfloat16}
+    model = GraphSAGESupervised(dims=dims, label_dim=2, conv_kwargs=conv_kwargs)
 
     def batch_fn():
         roots = graph.sample_node(batch_size, rng=np.random.default_rng())

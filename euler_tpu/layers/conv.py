@@ -30,9 +30,14 @@ def degrees(block: Block, with_self: bool = True) -> tuple[jnp.ndarray, jnp.ndar
 
 
 class Conv(nn.Module):
-    """Base conv: subclasses implement __call__(x_dst, x_src, block)."""
+    """Base conv: subclasses implement __call__(x_dst, x_src, block).
+
+    dtype is the flax compute dtype for the layer matmuls: params stay
+    f32 while dtype=jnp.bfloat16 runs the MXU in bf16 (mixed precision).
+    """
 
     out_dim: int = 0
+    dtype: object = None
 
     def msg(self, x_src, block: Block):
         return gather(x_src, block.edge_src)
@@ -57,7 +62,7 @@ class GCNConv(Conv):
         msgs = self.msg(x_src, block) * norm_src
         aggregated = self.agg_add(msgs, block)
         h = (aggregated + x_dst) * norm_dst[:, None]
-        return nn.Dense(self.out_dim, use_bias=self.use_bias)(h)
+        return nn.Dense(dtype=self.dtype, features=self.out_dim, use_bias=self.use_bias)(h)
 
 
 class SAGEConv(Conv):
@@ -97,7 +102,7 @@ class SAGEConv(Conv):
             )
             mean = total / jnp.maximum(count, 1.0)[:, None]
         h = jnp.concatenate([x_dst, mean], axis=-1)
-        return nn.Dense(self.out_dim, use_bias=self.use_bias)(h)
+        return nn.Dense(dtype=self.dtype, features=self.out_dim, use_bias=self.use_bias)(h)
 
 
 class GATConv(Conv):
@@ -107,11 +112,11 @@ class GATConv(Conv):
 
     @nn.compact
     def __call__(self, x_dst, x_src, block: Block):
-        w = nn.Dense(self.out_dim, use_bias=False)
+        w = nn.Dense(dtype=self.dtype, features=self.out_dim, use_bias=False)
         h_dst = w(x_dst)
         h_src = w(x_src)
-        a_src = nn.Dense(1, use_bias=False)(h_src)[:, 0]
-        a_dst = nn.Dense(1, use_bias=False)(h_dst)[:, 0]
+        a_src = nn.Dense(dtype=self.dtype, features=1, use_bias=False)(h_src)[:, 0]
+        a_dst = nn.Dense(dtype=self.dtype, features=1, use_bias=False)(h_dst)[:, 0]
         e = gather(a_src, block.edge_src) + gather(a_dst, block.edge_dst)
         e = nn.leaky_relu(e, self.negative_slope)
         alpha = scatter_softmax(e, block.edge_dst, block.n_dst, mask=block.mask)
@@ -133,9 +138,9 @@ class GINConv(Conv):
         agg = self.agg_add(self.msg(x_src, block), block)
         h = (1.0 + eps) * x_dst + agg
         hidden = self.hidden_dim or self.out_dim
-        h = nn.Dense(hidden)(h)
+        h = nn.Dense(dtype=self.dtype, features=hidden)(h)
         h = nn.relu(h)
-        return nn.Dense(self.out_dim)(h)
+        return nn.Dense(dtype=self.dtype, features=self.out_dim)(h)
 
 
 class GraphConv(Conv):
@@ -144,8 +149,7 @@ class GraphConv(Conv):
     @nn.compact
     def __call__(self, x_dst, x_src, block: Block):
         agg = self.agg_add(self.msg(x_src, block), block)
-        return nn.Dense(self.out_dim)(x_dst) + nn.Dense(
-            self.out_dim, use_bias=False
+        return nn.Dense(dtype=self.dtype, features=self.out_dim)(x_dst) + nn.Dense(dtype=self.dtype, features=self.out_dim, use_bias=False
         )(agg)
 
 
@@ -187,7 +191,7 @@ class TAGConv(Conv):
         deg_dst = degrees(block)
         norm = jnp.power(deg_dst, -0.5)[:, None]
         prop = (self.agg_add(self.msg(x_src, block), block) + x_dst) * norm
-        return nn.Dense(self.out_dim)(jnp.concatenate([x_dst, prop], axis=-1))
+        return nn.Dense(dtype=self.dtype, features=self.out_dim)(jnp.concatenate([x_dst, prop], axis=-1))
 
 
 class AGNNConv(Conv):
@@ -224,8 +228,8 @@ class ARMAConv(Conv):
         for _ in range(self.stacks):
             outs.append(
                 nn.relu(
-                    nn.Dense(self.out_dim, use_bias=False)(prop)
-                    + nn.Dense(self.out_dim)(x_dst)
+                    nn.Dense(dtype=self.dtype, features=self.out_dim, use_bias=False)(prop)
+                    + nn.Dense(dtype=self.dtype, features=self.out_dim)(x_dst)
                 )
             )
         return sum(outs) / self.stacks
@@ -240,9 +244,9 @@ class DNAConv(Conv):
     @nn.compact
     def __call__(self, x_dst, x_src, block: Block):
         d = self.out_dim
-        q = nn.Dense(d, use_bias=False)(x_dst)
-        kk = nn.Dense(d, use_bias=False)(x_src)
-        v = nn.Dense(d, use_bias=False)(x_src)
+        q = nn.Dense(dtype=self.dtype, features=d, use_bias=False)(x_dst)
+        kk = nn.Dense(dtype=self.dtype, features=d, use_bias=False)(x_src)
+        v = nn.Dense(dtype=self.dtype, features=d, use_bias=False)(x_src)
         e = jnp.sum(
             gather(kk, block.edge_src) * gather(q, block.edge_dst), axis=-1
         ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -261,9 +265,9 @@ class GatedGraphConv(Conv):
         h = x_dst if pad == 0 else jnp.pad(x_dst, ((0, 0), (0, max(pad, 0))))
         h = h[:, :d]
         m = self.agg_add(
-            nn.Dense(d, use_bias=False)(self.msg(x_src, block)), block
+            nn.Dense(dtype=self.dtype, features=d, use_bias=False)(self.msg(x_src, block)), block
         )
-        gru = nn.GRUCell(features=d)
+        gru = nn.GRUCell(dtype=self.dtype, features=d)
         _, out = gru(h, m)
         return out
 
@@ -278,7 +282,7 @@ class RelationConv(Conv):
     @nn.compact
     def __call__(self, x_dst, x_src, rel_blocks):
         d_in = x_src.shape[-1]
-        out = nn.Dense(self.out_dim)(x_dst)
+        out = nn.Dense(dtype=self.dtype, features=self.out_dim)(x_dst)
         if self.num_bases:
             basis = self.param(
                 "basis",
@@ -337,8 +341,8 @@ class LGCNConv(Conv):
         topk = jnp.swapaxes(topk, 1, 2)  # [n_dst, k, F]
         seq = jnp.concatenate([x_dst[:, None, :], topk], axis=1)
         kernel = self.k // 2 + 1
-        h = nn.Conv(self.hidden_dim, (kernel,), padding="VALID")(seq)
-        h = nn.Conv(self.out_dim, (kernel,), padding="VALID")(h)
+        h = nn.Conv(dtype=self.dtype, features=self.hidden_dim, kernel_size=(kernel,), padding="VALID")(seq)
+        h = nn.Conv(dtype=self.dtype, features=self.out_dim, kernel_size=(kernel,), padding="VALID")(h)
         return h[:, 0, :]
 
 
@@ -349,9 +353,9 @@ class GeniePathConv(Conv):
     @nn.compact
     def __call__(self, x_dst, x_src, block: Block, carry=None):
         d = self.out_dim
-        w = nn.Dense(d, use_bias=False)
+        w = nn.Dense(dtype=self.dtype, features=d, use_bias=False)
         h_src, h_dst = w(x_src), w(x_dst)
-        a = nn.Dense(1, use_bias=False)
+        a = nn.Dense(dtype=self.dtype, features=1, use_bias=False)
         e = nn.tanh(
             a(gather(h_src, block.edge_src) + gather(h_dst, block.edge_dst))
         )[:, 0]
@@ -359,7 +363,7 @@ class GeniePathConv(Conv):
         breadth = self.agg_add(
             gather(h_src, block.edge_src) * alpha[:, None], block
         )
-        lstm = nn.LSTMCell(features=d)
+        lstm = nn.LSTMCell(dtype=self.dtype, features=d)
         if carry is None:
             carry = lstm.initialize_carry(
                 jax.random.PRNGKey(0), breadth.shape

@@ -28,13 +28,16 @@ class _EncodedGNN(nn.Module):
     dims: Sequence[int]
     encoder_dim: int = 0  # 0 → raw features
     max_id: int = 0
+    conv_kwargs: dict | None = None
 
     def setup(self):
         if self.encoder_dim:
             self.encoder = ShallowEncoder(
                 dim=self.encoder_dim, max_id=self.max_id
             )
-        self.gnn = GNNNet(conv=self.conv, dims=self.dims)
+        self.gnn = GNNNet(
+            conv=self.conv, dims=self.dims, conv_kwargs=self.conv_kwargs
+        )
 
     def __call__(self, batch: MiniBatch) -> jnp.ndarray:
         if not self.encoder_dim:
@@ -55,6 +58,7 @@ class GraphSAGESupervised(nn.Module):
     encoder_dim: int = 0
     max_id: int = 0
     conv: str = "sage"
+    conv_kwargs: dict | None = None
 
     def setup(self):
         self.net = _EncodedGNN(
@@ -62,6 +66,7 @@ class GraphSAGESupervised(nn.Module):
             dims=self.dims,
             encoder_dim=self.encoder_dim,
             max_id=self.max_id,
+            conv_kwargs=self.conv_kwargs,
         )
         self.out = nn.Dense(self.label_dim)
 
@@ -81,6 +86,7 @@ class GraphSAGEUnsupervised(nn.Module):
     encoder_dim: int = 0
     max_id: int = 0
     conv: str = "sage"
+    conv_kwargs: dict | None = None
 
     def setup(self):
         self.net = _EncodedGNN(
@@ -88,6 +94,7 @@ class GraphSAGEUnsupervised(nn.Module):
             dims=self.dims,
             encoder_dim=self.encoder_dim,
             max_id=self.max_id,
+            conv_kwargs=self.conv_kwargs,
         )
 
     def embed(self, batch: MiniBatch) -> jnp.ndarray:

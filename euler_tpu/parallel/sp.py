@@ -1,0 +1,64 @@
+"""Subgraph (edge-axis) parallelism — the GNN analog of sequence/context
+parallelism.
+
+In an LLM trainer, sequence parallelism shards the token axis; in a GNN
+the blow-up axis is the fanout product (SURVEY.md §5: `sample_fanout`
+output is [batch, k0, k0·k1, …]). For very large fanouts or whole-graph
+batches, one device need not hold a hop's full edge set: these helpers
+shard the EDGE axis of a block across a mesh axis with `shard_map` — each
+device scatter-adds its edge slice into a full-size destination table and
+a `psum` over the axis combines the partials, riding ICI exactly like a
+ring-attention block-sum.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from euler_tpu.ops import scatter_add
+from euler_tpu.parallel.mesh import MODEL_AXIS
+
+
+def sp_segment_sum(
+    msgs, dst, n_dst: int, mesh: Mesh, axis: str = MODEL_AXIS, mask=None
+):
+    """Segment-sum msgs[e] into n_dst rows with edges sharded over `axis`.
+
+    msgs f32[E, F], dst i32[E], mask bool[E]; the axis size must divide E.
+    Each device reduces its local edge slice, then partials psum across the
+    axis — communication is O(n_dst · F) per device, independent of E.
+    """
+    if mask is None:
+        mask = jnp.ones(dst.shape[0], dtype=bool)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P(),
+    )
+    def f(m, d, mk):
+        part = scatter_add(m, d, n_dst, mask=mk)
+        return jax.lax.psum(part, axis)
+
+    return f(msgs, dst, mask)
+
+
+def sp_segment_mean(
+    msgs, dst, n_dst: int, mesh: Mesh, axis: str = MODEL_AXIS, mask=None
+):
+    """Masked segment mean over a sharded edge axis.
+
+    One fused collective: a ones column rides along with msgs so the sum
+    and the count come out of a single shard_map + psum.
+    """
+    ones = jnp.ones((dst.shape[0], 1), msgs.dtype)
+    both = sp_segment_sum(
+        jnp.concatenate([msgs, ones], axis=1), dst, n_dst, mesh, axis, mask
+    )
+    total, count = both[:, :-1], both[:, -1:]
+    return total / jnp.maximum(count, 1.0)
