@@ -13,6 +13,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -225,8 +226,43 @@ struct Csr {
   }
 };
 
+// Per-op timing counters (SURVEY.md §5: the host engine exports per-op
+// timings; the reference has common/timmer.h). Index = Op enum below.
+enum Op : int {
+  kOpLookup = 0,
+  kOpSampleNode,
+  kOpSampleEdge,
+  kOpSampleNeighbor,
+  kOpGetDense,
+  kOpRandomWalk,
+  kOpSampleFanout,
+  kNumOps,
+};
+
+struct OpStats {
+  std::atomic<u64> calls[kNumOps] = {};
+  std::atomic<u64> nanos[kNumOps] = {};
+};
+
+struct ScopedTimer {
+  OpStats& st;
+  int op;
+  std::chrono::steady_clock::time_point t0;
+  ScopedTimer(OpStats& s, int o) : st(s), op(o) {
+    t0 = std::chrono::steady_clock::now();
+  }
+  ~ScopedTimer() {
+    auto dt = std::chrono::steady_clock::now() - t0;
+    st.calls[op].fetch_add(1, std::memory_order_relaxed);
+    st.nanos[op].fetch_add(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(dt).count(),
+        std::memory_order_relaxed);
+  }
+};
+
 struct Store {
   MappedDir dir;
+  OpStats stats;
   const u64* node_ids = nullptr;
   const i32* node_types = nullptr;
   const f32* node_weights = nullptr;
@@ -293,6 +329,33 @@ struct Store {
   }
 };
 
+// One weighted neighbor draw for `row`: weighted type pick over `tot`
+// (catch-all last type), then an in-row cumulative-weight sample. Shared by
+// the per-hop and fused fanout kernels so their distributions stay in
+// lockstep. Returns {nullptr, -1, -1} when the row is missing or empty.
+struct NeighborPick {
+  const Csr* csr;
+  i64 el;
+  i32 type;
+};
+
+inline NeighborPick PickNeighbor(const Store* s, i64 row, const i32* types,
+                                 i64 ntypes, const double* tot, double total,
+                                 SplitMix64& rng) {
+  if (row < 0 || total <= 0) return {nullptr, -1, -1};
+  double u = rng.uniform() * total;
+  i64 pick = 0;
+  double acc = 0.0;
+  for (; pick < ntypes - 1; ++pick) {
+    acc += tot[pick];
+    if (u < acc) break;
+  }
+  const Csr& c = s->adj[types[pick]];
+  i64 el = c.SampleInRow(row, rng);
+  if (el < 0) return {nullptr, -1, -1};
+  return {&c, el, types[pick]};
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------- C ABI
@@ -315,6 +378,7 @@ i64 etpu_num_edges(void* h) { return ((Store*)h)->num_edges; }
 
 void etpu_lookup(void* h, const u64* ids, i64 n, i64* rows) {
   auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpLookup);
   ParallelFor(n, 4096, [&](i64 lo, i64 hi) {
     for (i64 i = lo; i < hi; ++i) rows[i] = s->Lookup(ids[i]);
   });
@@ -322,6 +386,7 @@ void etpu_lookup(void* h, const u64* ids, i64 n, i64* rows) {
 
 void etpu_sample_node(void* h, i64 count, i32 node_type, u64 seed, u64* out) {
   auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpSampleNode);
   i64 ti = node_type < 0 ? s->num_node_types : node_type;
   const AliasTable& at = s->node_samplers[ti];
   ParallelFor(count, 8192, [&](i64 lo, i64 hi) {
@@ -335,6 +400,7 @@ void etpu_sample_node(void* h, i64 count, i32 node_type, u64 seed, u64* out) {
 
 void etpu_sample_edge(void* h, i64 count, i32 edge_type, u64 seed, u64* out) {
   auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpSampleEdge);
   i64 ti = edge_type < 0 ? s->num_edge_types : edge_type;
   const AliasTable& at = s->edge_samplers[ti];
   ParallelFor(count, 8192, [&](i64 lo, i64 hi) {
@@ -357,6 +423,7 @@ void etpu_sample_neighbor(void* h, const u64* ids, i64 n, const i32* types,
                           i64 ntypes, i64 count, u64 seed, u64* nbr, f32* w,
                           i32* tt, u8* mask, i64* eidx) {
   auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpSampleNeighbor);
   std::vector<i32> all_types;
   if (ntypes == 0) {
     for (i64 t = 0; t < s->num_edge_types; ++t) all_types.push_back((i32)t);
@@ -380,22 +447,14 @@ void etpu_sample_neighbor(void* h, const u64* ids, i64 n, const i32* types,
         tt[o] = -1;
         mask[o] = 0;
         eidx[o] = -1;
-        if (row < 0 || total <= 0) continue;
-        double u = rng.uniform() * total;
-        i64 pick = 0;
-        double acc = 0.0;
-        for (; pick < ntypes - 1; ++pick) {
-          acc += tot[pick];
-          if (u < acc) break;
-        }
-        const Csr& c2 = s->adj[types[pick]];
-        i64 el = c2.SampleInRow(row, rng);
-        if (el < 0) continue;
-        nbr[o] = c2.dst[el];
-        w[o] = c2.w[el];
-        tt[o] = types[pick];
+        NeighborPick p =
+            PickNeighbor(s, row, types, ntypes, tot.data(), total, rng);
+        if (p.el < 0) continue;
+        nbr[o] = p.csr->dst[p.el];
+        w[o] = p.csr->w[p.el];
+        tt[o] = p.type;
         mask[o] = 1;
-        eidx[o] = c2.eidx ? c2.eidx[el] : -1;
+        eidx[o] = p.csr->eidx ? p.csr->eidx[p.el] : -1;
       }
     }
   });
@@ -405,6 +464,7 @@ void etpu_sample_neighbor(void* h, const u64* ids, i64 n, const i32* types,
 void etpu_get_dense(void* h, const u64* ids, i64 n, i64 fid, i64 dim,
                     f32* out) {
   auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpGetDense);
   std::string name = "nf_dense_" + std::to_string(fid);
   i64 rows_n = 0;
   const f32* table = s->dir.Get<f32>(name, &rows_n);
@@ -423,10 +483,124 @@ void etpu_get_dense(void* h, const u64* ids, i64 n, i64 fid, i64 dim,
   });
 }
 
+// Fused multi-hop fanout (one call per batch instead of one per hop).
+// Hop h occupies n*prod(counts[:h]) slots, regions appended in hop order
+// (hop 0 echoes the roots). rows_out carries each slot's local store row
+// (-1 when missing/padded) so callers can feed device feature caches
+// without a second lookup pass.
+void etpu_sample_fanout(void* h, const u64* roots, i64 n, const i32* types,
+                        i64 ntypes, const i64* counts, i64 num_hops, u64 seed,
+                        u64* ids_out, i64* rows_out, f32* w_out, i32* tt_out,
+                        u8* mask_out) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpSampleFanout);
+  std::vector<i32> all_types;
+  if (ntypes == 0) {
+    for (i64 t = 0; t < s->num_edge_types; ++t) all_types.push_back((i32)t);
+    types = all_types.data();
+    ntypes = all_types.size();
+  }
+  // hop 0: echo roots, resolve rows
+  ParallelFor(n, 2048, [&](i64 lo, i64 hi) {
+    for (i64 i = lo; i < hi; ++i) {
+      i64 row = roots[i] == kDefaultId ? -1 : s->Lookup(roots[i]);
+      ids_out[i] = roots[i];
+      rows_out[i] = row;
+      w_out[i] = 1.f;
+      tt_out[i] = row < 0 ? -1 : s->node_types[row];
+      mask_out[i] = row >= 0;
+    }
+  });
+  i64 off = 0, width = n;
+  for (i64 hop = 0; hop < num_hops; ++hop) {
+    i64 cnt = counts[hop];
+    i64 next_off = off + width;
+    const i64* frow = rows_out + off;
+    u64* nbr = ids_out + next_off;
+    i64* nrow = rows_out + next_off;
+    f32* nw = w_out + next_off;
+    i32* ntt = tt_out + next_off;
+    u8* nm = mask_out + next_off;
+    ParallelFor(width, 256, [&](i64 lo, i64 hi) {
+      SplitMix64 rng(seed ^ (0x94d049bb133111ebull * (u64)(hop + 1)) ^
+                     (0x2545f4914f6cdd1dull * (u64)(lo + 1)));
+      std::vector<double> tot(ntypes);
+      for (i64 i = lo; i < hi; ++i) {
+        i64 row = frow[i];
+        double total = 0.0;
+        for (i64 k = 0; k < ntypes; ++k) {
+          tot[k] = row < 0 ? 0.0 : s->adj[types[k]].RowWeight(row);
+          total += tot[k];
+        }
+        for (i64 c = 0; c < cnt; ++c) {
+          i64 o = i * cnt + c;
+          nbr[o] = kDefaultId;
+          nrow[o] = -1;
+          nw[o] = 0.f;
+          ntt[o] = -1;
+          nm[o] = 0;
+          NeighborPick p =
+              PickNeighbor(s, row, types, ntypes, tot.data(), total, rng);
+          if (p.el < 0) continue;
+          nbr[o] = p.csr->dst[p.el];
+          nrow[o] = s->Lookup(p.csr->dst[p.el]);
+          nw[o] = p.csr->w[p.el];
+          ntt[o] = p.type;
+          nm[o] = 1;
+        }
+      }
+    });
+    off = next_off;
+    width *= cnt;
+  }
+}
+
+// Per-op stats: out[0..kNumOps) = call counts, out[kNumOps..2*kNumOps) = ns.
+void etpu_stats(void* h, u64* out) {
+  auto* s = (Store*)h;
+  for (int op = 0; op < kNumOps; ++op) {
+    out[op] = s->stats.calls[op].load(std::memory_order_relaxed);
+    out[kNumOps + op] = s->stats.nanos[op].load(std::memory_order_relaxed);
+  }
+}
+
+void etpu_reset_stats(void* h) {
+  auto* s = (Store*)h;
+  for (int op = 0; op < kNumOps; ++op) {
+    s->stats.calls[op].store(0, std::memory_order_relaxed);
+    s->stats.nanos[op].store(0, std::memory_order_relaxed);
+  }
+}
+
+// Dense feature fetch by pre-resolved store rows (-1 → zeros). Skips the
+// per-id binary search when the caller already has rows (e.g. from
+// etpu_sample_fanout's rows_out).
+void etpu_get_dense_rows(void* h, const i64* rows, i64 n, i64 fid, i64 dim,
+                         f32* out) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpGetDense);
+  std::string name = "nf_dense_" + std::to_string(fid);
+  i64 rows_n = 0;
+  const f32* table = s->dir.Get<f32>(name, &rows_n);
+  if (!table) {
+    memset(out, 0, sizeof(f32) * n * dim);
+    return;
+  }
+  ParallelFor(n, 2048, [&](i64 lo, i64 hi) {
+    for (i64 i = lo; i < hi; ++i) {
+      if (rows[i] < 0 || rows[i] >= rows_n)
+        memset(out + i * dim, 0, sizeof(f32) * dim);
+      else
+        memcpy(out + i * dim, table + rows[i] * dim, sizeof(f32) * dim);
+    }
+  });
+}
+
 // Uniform/weighted random walk (p=q=1 fast path). Output [n, len+1].
 void etpu_random_walk(void* h, const u64* ids, i64 n, const i32* types,
                       i64 ntypes, i64 walk_len, u64 seed, u64* out) {
   auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpRandomWalk);
   std::vector<i32> all_types;
   if (ntypes == 0) {
     for (i64 t = 0; t < s->num_edge_types; ++t) all_types.push_back((i32)t);

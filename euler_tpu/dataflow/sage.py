@@ -36,23 +36,61 @@ class SageDataFlow(DataFlow):
 
     def query(self, roots: np.ndarray) -> MiniBatch:
         roots = np.asarray(roots, dtype=np.uint64)
-        batch = len(roots)
-        hop_ids = [roots]
-        hop_masks = [roots != DEFAULT_ID]
-        blocks = []
-        cur = roots
-        for k in self.fanouts:
-            nbr, w, _, mask, _ = self.graph.sample_neighbor(
-                cur, self.edge_types, k, rng=self.rng
-            )
-            blocks.append(
-                fanout_block(len(cur), k, w, mask, lazy=self.lazy_blocks)
-            )
-            cur = nbr.reshape(-1)
-            hop_ids.append(cur)
-            hop_masks.append(mask.reshape(-1))
-        # padded slots hold DEFAULT_ID → feature fetch returns zeros
-        feats = tuple(self.node_feats(ids) for ids in hop_ids)
+        fused = getattr(self.graph, "fanout_with_rows", None)
+        res = (
+            fused(roots, self.edge_types, self.fanouts, rng=self.rng)
+            if fused is not None
+            else None
+        )
+        if res is not None:
+            # fused path: one native-engine call yields every hop's ids,
+            # weights, masks AND feature-cache rows
+            hop_ids, hop_w, _, hop_masks, hop_rows = res
+            # hop-0 validity matches the fallback path (any non-default id
+            # counts, even if absent from the store — its features are zero)
+            hop_masks = [roots != DEFAULT_ID] + list(hop_masks[1:])
+            blocks = []
+            width = len(roots)
+            for k, w, mask in zip(self.fanouts, hop_w[1:], hop_masks[1:]):
+                blocks.append(
+                    fanout_block(width, k, w, mask, lazy=self.lazy_blocks)
+                )
+                width *= k
+            if self.feature_mode == "rows":
+                feats = tuple(
+                    np.where(r >= 0, r + 1, 0).astype(np.int32)
+                    for r in hop_rows
+                )
+            elif self.feature_names and hasattr(
+                self.graph.shards[0], "get_dense_by_rows"
+            ):
+                # reuse the rows the fanout already resolved — no second
+                # per-id lookup pass
+                feats = tuple(
+                    self.graph.shards[0].get_dense_by_rows(
+                        r, self.feature_names
+                    )
+                    for r in hop_rows
+                )
+            else:
+                feats = tuple(self.node_feats(ids) for ids in hop_ids)
+        else:
+            hop_ids = [roots]
+            hop_masks = [roots != DEFAULT_ID]
+            blocks = []
+            cur = roots
+            for k in self.fanouts:
+                nbr, w, _, mask, _ = self.graph.sample_neighbor(
+                    cur, self.edge_types, k, rng=self.rng
+                )
+                blocks.append(
+                    fanout_block(len(cur), k, w, mask, lazy=self.lazy_blocks)
+                )
+                cur = nbr.reshape(-1)
+                hop_ids.append(cur)
+                hop_masks.append(mask.reshape(-1))
+            # padded slots hold DEFAULT_ID → feature fetch returns zeros
+            feats = tuple(self.node_feats(ids) for ids in hop_ids)
         return MiniBatch(
             feats=feats,
             masks=tuple(hop_masks),

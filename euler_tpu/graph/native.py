@@ -88,8 +88,29 @@ def _load_lib():
         c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_int64,
         c.c_uint64, u64p,
     ]
+    lib.etpu_sample_fanout.argtypes = [
+        c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, i64p, c.c_int64,
+        c.c_uint64, u64p, i64p, f32p, i32p, u8p,
+    ]
+    lib.etpu_get_dense_rows.argtypes = [
+        c.c_void_p, i64p, c.c_int64, c.c_int64, c.c_int64, f32p,
+    ]
+    lib.etpu_stats.argtypes = [c.c_void_p, u64p]
+    lib.etpu_reset_stats.argtypes = [c.c_void_p]
     _lib = lib
     return lib
+
+
+# per-op counters exported by the engine (Op enum order in graph_engine.cc)
+STAT_OPS = (
+    "lookup",
+    "sample_node",
+    "sample_edge",
+    "sample_neighbor",
+    "get_dense",
+    "random_walk",
+    "sample_fanout",
+)
 
 
 def engine_available() -> bool:
@@ -211,6 +232,93 @@ class NativeGraphStore(GraphStore):
             if cols
             else np.zeros((len(ids), 0), np.float32)
         )
+
+    def fanout_with_rows(self, ids, edge_types, counts, rng=None):
+        """Fused multi-hop fanout in one engine call.
+
+        Returns (hop_ids, hop_w, hop_tt, hop_mask, hop_rows) — lists over
+        hops 0..len(counts), hop i flat with n*prod(counts[:i]) entries.
+        hop_rows are local store rows (-1 invalid), ready for the device
+        feature cache without a second lookup pass.
+        """
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        n = len(ids)
+        types = np.ascontiguousarray(
+            [] if edge_types is None else list(edge_types), dtype=np.int32
+        )
+        counts_arr = np.ascontiguousarray(counts, dtype=np.int64)
+        widths = [n]
+        for c in counts:
+            widths.append(widths[-1] * int(c))
+        total = int(np.sum(widths))
+        ids_out = np.empty(total, dtype=np.uint64)
+        rows_out = np.empty(total, dtype=np.int64)
+        w_out = np.empty(total, dtype=np.float32)
+        tt_out = np.empty(total, dtype=np.int32)
+        mask_out = np.empty(total, dtype=np.uint8)
+        ct = ctypes
+        self._lib.etpu_sample_fanout(
+            ct.c_void_p(self._h),
+            _u64p(ids),
+            n,
+            types.ctypes.data_as(ct.POINTER(ct.c_int32)),
+            len(types),
+            counts_arr.ctypes.data_as(ct.POINTER(ct.c_int64)),
+            len(counts),
+            ct.c_uint64(self._seed(rng)),
+            _u64p(ids_out),
+            rows_out.ctypes.data_as(ct.POINTER(ct.c_int64)),
+            w_out.ctypes.data_as(ct.POINTER(ct.c_float)),
+            tt_out.ctypes.data_as(ct.POINTER(ct.c_int32)),
+            mask_out.ctypes.data_as(ct.POINTER(ct.c_uint8)),
+        )
+        offs = np.r_[0, np.cumsum(widths)]
+        split = lambda a: [a[offs[i] : offs[i + 1]] for i in range(len(widths))]
+        return (
+            split(ids_out),
+            split(w_out),
+            split(tt_out),
+            [m.astype(bool) for m in split(mask_out)],
+            split(rows_out),
+        )
+
+    def get_dense_by_rows(self, rows, names):
+        """Dense features by pre-resolved rows (-1 → zeros); skips lookup."""
+        rows = np.ascontiguousarray(rows, dtype=np.int64)
+        cols = []
+        for nm in names:
+            spec = self.meta.feature_spec(nm, node=True)
+            out = np.empty((len(rows), spec.dim), dtype=np.float32)
+            self._lib.etpu_get_dense_rows(
+                ctypes.c_void_p(self._h),
+                rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(rows),
+                spec.fid,
+                spec.dim,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            )
+            cols.append(out)
+        return (
+            np.concatenate(cols, axis=1)
+            if cols
+            else np.zeros((len(rows), 0), np.float32)
+        )
+
+    def op_stats(self) -> dict:
+        """Per-op (calls, total_ms) timing counters from the engine."""
+        out = np.zeros(2 * len(STAT_OPS), dtype=np.uint64)
+        self._lib.etpu_stats(
+            ctypes.c_void_p(self._h),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+        k = len(STAT_OPS)
+        return {
+            name: {"calls": int(out[i]), "ms": float(out[k + i]) / 1e6}
+            for i, name in enumerate(STAT_OPS)
+        }
+
+    def reset_op_stats(self):
+        self._lib.etpu_reset_stats(ctypes.c_void_p(self._h))
 
     def random_walk(self, ids, edge_types=None, walk_len=3, p=1.0, q=1.0, rng=None):
         if p != 1.0 or q != 1.0:  # node2vec bias → numpy path

@@ -950,6 +950,14 @@ class Graph:
             hops.append((cur, w.reshape(-1), tt.reshape(-1), mask.reshape(-1)))
         return hops
 
+    def fanout_with_rows(self, ids, edge_types, counts, rng=None):
+        """Fused multi-hop fanout incl. feature-cache rows, or None when
+        unsupported (multi-shard or non-native store). Single engine call
+        per batch — the hot path for sampled training."""
+        if self.num_shards == 1 and hasattr(self.shards[0], "fanout_with_rows"):
+            return self.shards[0].fanout_with_rows(ids, edge_types, counts, rng)
+        return None
+
     def sample_neighbor_layerwise(self, batch_ids, edge_types=None, count=128, rng=None):
         """Single-shard path for now; multi-shard merges candidate sets."""
         rng = _rng(rng)

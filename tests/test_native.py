@@ -97,3 +97,93 @@ def test_missing_ids(native_pair):
         np.asarray([777], np.uint64), None, 4
     )
     assert not mask.any()
+
+
+@pytest.fixture(scope="module")
+def native_single(tmp_path_factory, fixture_graph_dict):
+    d = tmp_path_factory.mktemp("g1")
+    convert_json(fixture_graph_dict, str(d), num_partitions=1)
+    return Graph.load(str(d), native=True)
+
+
+def test_fused_fanout(native_single):
+    g = native_single
+    rng = np.random.default_rng(0)
+    roots = np.asarray([1, 2, 3, 4], np.uint64)
+    hop_ids, hop_w, hop_tt, hop_mask, hop_rows = g.fanout_with_rows(
+        roots, None, [3, 2], rng=rng
+    )
+    assert [len(h) for h in hop_ids] == [4, 12, 24]
+    # hop 0 echoes roots with their types and rows
+    np.testing.assert_array_equal(hop_ids[0], roots)
+    np.testing.assert_array_equal(hop_tt[0], g.node_type(roots))
+    assert (hop_rows[0] == g.shards[0].lookup(roots)).all()
+    # sampled neighbors are true neighbors; rows resolve their ids
+    for hop in (1, 2):
+        valid = hop_mask[hop]
+        assert valid.any()
+        rows = hop_rows[hop][valid]
+        np.testing.assert_array_equal(
+            g.shards[0].node_ids[rows], hop_ids[hop][valid]
+        )
+        assert (hop_w[hop][valid] > 0).all()
+        assert (hop_ids[hop][~valid] == np.uint64(0xFFFFFFFFFFFFFFFF)).all()
+    # every valid hop-1 sample is an actual out-neighbor of its root
+    full, _, _, fmask, _ = g.get_full_neighbor(roots, None)
+    for i in range(4):
+        allowed = set(full[i][fmask[i]].tolist())
+        got = hop_ids[1][i * 3 : (i + 1) * 3]
+        for x, ok in zip(got, hop_mask[1][i * 3 : (i + 1) * 3]):
+            if ok:
+                assert int(x) in allowed
+
+
+def test_fused_fanout_via_dataflow(native_single):
+    from euler_tpu.dataflow import SageDataFlow
+
+    g = native_single
+    flow = SageDataFlow(
+        g, ["dense2"], fanouts=[3, 2], rng=np.random.default_rng(1),
+        feature_mode="rows", lazy_blocks=True,
+    )
+    mb = flow.query(np.asarray([1, 2, 3, 4], np.uint64))
+    assert mb.feats[0].dtype == np.int32
+    # rows agree with lookup_rows (+1 shift, 0 = padding)
+    want = g.lookup_rows(np.asarray(mb.hop_ids[1], np.uint64))
+    got = mb.feats[1].astype(np.int64) - 1
+    np.testing.assert_array_equal(got[got >= 0], want[got >= 0])
+    table = g.dense_feature_table(["dense2"])
+    # hydrating rows must equal a direct feature fetch
+    direct = g.get_dense_feature(
+        np.asarray(mb.hop_ids[1], np.uint64), ["dense2"]
+    )
+    padded = np.concatenate([np.zeros((1, 2), np.float32), table])
+    np.testing.assert_allclose(padded[mb.feats[1]], direct)
+
+
+def test_op_stats(native_single):
+    g = native_single
+    store = g.shards[0]
+    store.reset_op_stats()
+    g.sample_node(8, rng=np.random.default_rng(0))
+    g.fanout_with_rows(
+        np.asarray([1, 2], np.uint64), None, [2], np.random.default_rng(0)
+    )
+    stats = store.op_stats()
+    assert stats["sample_node"]["calls"] == 1
+    assert stats["sample_fanout"]["calls"] == 1
+    assert stats["sample_fanout"]["ms"] >= 0.0
+    store.reset_op_stats()
+    assert store.op_stats()["sample_node"]["calls"] == 0
+
+
+def test_fused_fanout_dense_mode(native_single):
+    from euler_tpu.dataflow import SageDataFlow
+
+    g = native_single
+    flow = SageDataFlow(
+        g, ["dense2"], fanouts=[3], rng=np.random.default_rng(2)
+    )
+    mb = flow.query(np.asarray([1, 2, 3], np.uint64))
+    direct = g.get_dense_feature(np.asarray(mb.hop_ids[1], np.uint64), ["dense2"])
+    np.testing.assert_allclose(mb.feats[1], direct)
