@@ -2,3 +2,4 @@ from euler_tpu.graph.builder import build_from_json, convert_json  # noqa: F401
 from euler_tpu.graph.format import read_arrays, write_arrays  # noqa: F401
 from euler_tpu.graph.meta import BINARY, DENSE, SPARSE, FeatureSpec, GraphMeta  # noqa: F401
 from euler_tpu.graph.store import DEFAULT_ID, Graph, GraphStore  # noqa: F401
+from euler_tpu.graph.backends import open_graph, register_backend  # noqa: F401

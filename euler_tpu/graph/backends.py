@@ -1,0 +1,61 @@
+"""Pluggable graph-backend registry.
+
+The reference lets every graph op route to an alternative store (the
+NebulaGraph backend is toggled per-op via a `nebula_ops` dict,
+tf_euler/python/euler_ops/base.py:30-127). Here the seam is the `Graph`
+facade itself: anything exposing its query surface can serve the dataflow
+and model stack. Backends register a URI scheme; `open_graph` dispatches:
+
+    open_graph("/data/mygraph")                  # local shards (+C++ engine)
+    open_graph("remote:///shared/reg?shards=2")  # RPC cluster via registry
+    register_backend("mydb", opener)             # third-party store
+"""
+
+from __future__ import annotations
+
+from urllib.parse import parse_qs, urlparse
+
+
+def _open_local(path: str, **kw):
+    from euler_tpu.graph.store import Graph
+
+    return Graph.load(path, **kw)
+
+
+def _open_remote(uri, **kw):
+    from euler_tpu.distributed import connect
+
+    q = {k: v[-1] for k, v in parse_qs(uri.query).items()}
+    # the registry is a filesystem path, not host/path — accept both
+    # remote:///abs/reg (empty netloc) and remote://rel/reg forms
+    registry = (uri.netloc + uri.path) if uri.netloc else uri.path
+    return connect(
+        registry_path=registry,
+        num_shards=int(q["shards"]),
+        timeout=float(q.get("timeout", 30.0)),
+        **kw,
+    )
+
+
+BACKENDS = {
+    "local": lambda uri, **kw: _open_local(uri.path, **kw),
+    "remote": _open_remote,
+}
+
+
+def register_backend(scheme: str, opener) -> None:
+    """opener(parsed_uri, **kw) → Graph-like object."""
+    BACKENDS[scheme] = opener
+
+
+def open_graph(uri: str, **kw):
+    """Open a graph by path or <scheme>://… URI through the registry."""
+    parsed = urlparse(uri)
+    scheme = parsed.scheme or "local"
+    if scheme not in BACKENDS:
+        raise KeyError(
+            f"no graph backend for scheme {scheme!r}; have {sorted(BACKENDS)}"
+        )
+    if scheme == "local" and not parsed.scheme:
+        parsed = parsed._replace(path=uri)
+    return BACKENDS[scheme](parsed, **kw)

@@ -950,6 +950,30 @@ class Graph:
             hops.append((cur, w.reshape(-1), tt.reshape(-1), mask.reshape(-1)))
         return hops
 
+    def sparse_get_adj(self, ids, edge_types=None, max_degree=None):
+        """Induced adjacency among `ids` (sparse_get_adj kernel parity,
+        tf_euler kernels sparse_get_adj_op): COO (src_pos, dst_pos, w)
+        where positions index into `ids`; edges whose destination is not in
+        `ids` are dropped. Duplicate ids map to their first occurrence."""
+        ids = np.asarray(ids, dtype=np.uint64)
+        nbr, w, _, mask, _ = self.get_full_neighbor(
+            ids, edge_types, max_degree=max_degree
+        )
+        order = np.argsort(ids, kind="stable")
+        sorted_ids = ids[order]
+        pos = np.searchsorted(sorted_ids, nbr)
+        pos = np.clip(pos, 0, len(ids) - 1)
+        hit = (sorted_ids[pos] == nbr) & mask
+        dst_pos = order[pos]
+        src_pos = np.broadcast_to(
+            np.arange(len(ids))[:, None], nbr.shape
+        )
+        return (
+            src_pos[hit].astype(np.int64),
+            dst_pos[hit].astype(np.int64),
+            w[hit].astype(np.float32),
+        )
+
     def fanout_with_rows(self, ids, edge_types, counts, rng=None):
         """Fused multi-hop fanout incl. feature-cache rows, or None when
         unsupported (multi-shard or non-native store). Single engine call

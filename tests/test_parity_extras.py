@@ -169,3 +169,61 @@ def test_lgcn_fanout_guard(graph):
     layer = LGCNConv(out_dim=8, k=3)
     with pytest.raises(ValueError, match="fanout"):
         layer.init(jax.random.PRNGKey(0), mb.feats[0], mb.feats[1], mb.blocks[0])
+
+
+# ---- induced adjacency (sparse_get_adj parity) --------------------------
+
+
+def test_sparse_get_adj(graph):
+    ids = np.asarray([1, 2, 3, 4, 5], np.uint64)
+    src, dst, w = graph.sparse_get_adj(ids)
+    assert len(src) == len(dst) == len(w)
+    assert len(src) > 0
+    # every returned edge is a true edge between members of `ids`
+    full, fw, _, fmask, _ = graph.get_full_neighbor(ids)
+    for s, d, weight in zip(src, dst, w):
+        nbrs = full[s][fmask[s]]
+        assert ids[d] in nbrs
+        assert weight > 0
+    # edges to nodes outside `ids` are dropped: compare against total degree
+    total_edges = int(
+        sum(np.isin(full[i][fmask[i]], ids).sum() for i in range(len(ids)))
+    )
+    assert len(src) == total_edges
+
+
+# ---- backend registry ---------------------------------------------------
+
+
+def test_open_graph_local(tmp_path, graph):
+    from euler_tpu.graph import format as tformat
+    from euler_tpu.graph import open_graph
+
+    d = str(tmp_path / "g")
+    import os
+
+    for p, shard in enumerate(graph.shards):
+        tformat.write_arrays(os.path.join(d, f"part_{p}"), shard.arrays)
+    graph.meta.save(d)
+    g2 = open_graph(d, native=False)
+    assert g2.num_shards == graph.num_shards
+
+
+def test_register_backend():
+    from euler_tpu.graph import open_graph, register_backend
+    from euler_tpu.graph.backends import BACKENDS
+
+    seen = {}
+
+    def opener(uri, **kw):
+        seen["path"] = uri.path
+        return "fake-graph"
+
+    register_backend("testdb", opener)
+    try:
+        assert open_graph("testdb://host/db1") == "fake-graph"
+        assert seen["path"] == "/db1"
+        with pytest.raises(KeyError, match="no graph backend"):
+            open_graph("nope://x")
+    finally:
+        BACKENDS.pop("testdb", None)
