@@ -279,7 +279,11 @@ class Estimator:
         ckpt = ocp.PyTreeCheckpointer()
         ckpt.save(
             path,
-            {"params": self.params, "step": self.step},
+            {
+                "params": self.params,
+                "opt_state": self.opt_state,
+                "step": self.step,
+            },
             force=True,
         )
 
@@ -291,10 +295,27 @@ class Estimator:
             return False
         self._ensure_init()
         ckpt = ocp.PyTreeCheckpointer()
-        restored = ckpt.restore(path, item={"params": self.params, "step": 0})
+        # pre-opt_state checkpoints carry only params+step: detect by the
+        # checkpoint's own key layout, so genuine restore errors propagate
+        # instead of silently resetting optimizer slots
+        has_opt = "opt_state" in set(os.listdir(path))
+        if has_opt:
+            restored = ckpt.restore(
+                path,
+                item={
+                    "params": self.params,
+                    "opt_state": self.opt_state,
+                    "step": 0,
+                },
+            )
+            self.opt_state = restored["opt_state"]
+        else:
+            restored = ckpt.restore(
+                path, item={"params": self.params, "step": 0}
+            )
+            self.opt_state = self.tx.init(restored["params"])
         self.params = restored["params"]
         self.step = int(restored["step"])
-        self.opt_state = self.tx.init(self.params)
         return True
 
 

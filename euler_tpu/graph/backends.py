@@ -38,7 +38,10 @@ def _open_remote(uri, **kw):
 
 
 BACKENDS = {
-    "local": lambda uri, **kw: _open_local(uri.path, **kw),
+    # join netloc like _open_remote: "local://data/g" means ./data/g
+    "local": lambda uri, **kw: _open_local(
+        (uri.netloc + uri.path) if uri.netloc else uri.path, **kw
+    ),
     "remote": _open_remote,
 }
 

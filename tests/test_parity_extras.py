@@ -227,3 +227,34 @@ def test_register_backend():
             open_graph("nope://x")
     finally:
         BACKENDS.pop("testdb", None)
+
+
+# ---- checkpoint restores optimizer state --------------------------------
+
+
+def test_checkpoint_restores_opt_state(graph, tmp_path):
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.models import GraphSAGESupervised
+
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        graph, ["feat"], fanouts=[2], label_feature="label", rng=rng
+    )
+    cfg = EstimatorConfig(model_dir=str(tmp_path), log_steps=10**9)
+    est = Estimator(GraphSAGESupervised(dims=[8], label_dim=2),
+                    node_batches(graph, flow, 8, rng=rng), cfg)
+    est.train(total_steps=5, log=False)
+
+    est2 = Estimator(GraphSAGESupervised(dims=[8], label_dim=2),
+                     node_batches(graph, flow, 8, rng=rng), cfg)
+    assert est2.restore()
+    assert est2.step == 5
+    # adam second moments must carry over (nonzero), not restart at init
+    leaves = jax.tree.leaves(est2.opt_state)
+    nonzero = [
+        float(np.abs(np.asarray(x)).sum())
+        for x in leaves
+        if hasattr(x, "shape") and getattr(x, "size", 0) > 1
+    ]
+    assert any(v > 0 for v in nonzero), "optimizer slots were reset"
