@@ -296,9 +296,9 @@ class Estimator:
         self._ensure_init()
         ckpt = ocp.PyTreeCheckpointer()
         # pre-opt_state checkpoints carry only params+step: detect by the
-        # checkpoint's own key layout, so genuine restore errors propagate
+        # checkpoint's own metadata, so genuine restore errors propagate
         # instead of silently resetting optimizer slots
-        has_opt = "opt_state" in set(os.listdir(path))
+        has_opt = "opt_state" in set(ckpt.metadata(path).item_metadata.keys())
         if has_opt:
             restored = ckpt.restore(
                 path,
