@@ -72,6 +72,8 @@ def build_parser():
     ap.add_argument("--p", type=float, default=1.0)
     ap.add_argument("--q", type=float, default=1.0)
     ap.add_argument("--log-steps", type=int, default=20)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) before device init")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--data-parallel", type=int, default=0,
                     help="devices for a data-parallel mesh (0 = single)")
@@ -80,6 +82,12 @@ def build_parser():
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.platform:
+        # must land before the first device query; a plain JAX_PLATFORMS
+        # env var can be overridden by site-level config
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     from euler_tpu.datasets import get_dataset
     from euler_tpu.estimator import Estimator, EstimatorConfig, id_batches, node_batches
     from euler_tpu.graph import Graph

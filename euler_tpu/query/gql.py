@@ -194,6 +194,7 @@ class Query:
         inputs = inputs or {}
         rng = rng if rng is not None else np.random.default_rng()
         cur: np.ndarray | None = None  # current node frontier (u64)
+        cur_edges: np.ndarray | None = None  # [n,3] edge frontier after e/outE
         last: object = None  # last step's full result
         results: dict[str, object] = {}
 
@@ -222,6 +223,7 @@ class Query:
 
         for fn, args, conds in self.steps:
             if fn == "v":
+                cur_edges = None
                 cur = resolve_ids(args[0])
                 if conds:
                     cur = filter_frontier(cur, conds)
@@ -234,8 +236,10 @@ class Query:
                     )
                     edges = edges[keep]
                 cur = edges[:, 1]  # frontier = dst
+                cur_edges = edges
                 last = edges
             elif fn == "sampleN":
+                cur_edges = None
                 t, n = int(args[0]), int(args[1])
                 if conds:
                     cur = graph.sample_node_with_condition(
@@ -245,6 +249,7 @@ class Query:
                     cur = graph.sample_node(n, t, rng=rng)
                 last = cur
             elif fn == "sampleNWithTypes":
+                cur_edges = None
                 types, n = args[0], int(args[1])
                 types = types if isinstance(types, list) else [types]
                 per = [
@@ -266,7 +271,9 @@ class Query:
                 else:
                     last = graph.sample_edge(n, t, rng=rng)
                 cur = last[:, 1]
+                cur_edges = np.asarray(last, dtype=np.uint64)
             elif fn in ("sampleNB", "outV", "inV", "sampleLNB"):
+                cur_edges = None
                 *types, n = args if fn in ("sampleNB", "sampleLNB") else (
                     list(args) + [0]
                 )
@@ -318,19 +325,28 @@ class Query:
                 triples = np.stack(
                     [src, nbr, np.maximum(tt, 0).astype(np.uint64)], axis=-1
                 )  # [n, D, 3]
+                cur_edges = triples.reshape(-1, 3)
                 last = (triples, w, mask)
             elif fn == "values":
                 # one batched fetch for every referenced feature, then
-                # splice/aggregate per-arg columns in order
+                # splice/aggregate per-arg columns in order; after an edge
+                # step (e/sampleE/outE) this reads EDGE features, matching
+                # the reference's get_feature kernel accepting edge_ids
                 names = [
                     str(a[2][0]) if isinstance(a, tuple) else str(a)
                     for a in args
                 ]
                 if names:
+                    on_edges = cur_edges is not None
                     widths = [
-                        graph.meta.feature_spec(nm).dim for nm in names
+                        graph.meta.feature_spec(nm, node=not on_edges).dim
+                        for nm in names
                     ]
-                    flat = graph.get_dense_feature(cur, names)
+                    flat = (
+                        graph.get_edge_dense_feature(cur_edges, names)
+                        if on_edges
+                        else graph.get_dense_feature(cur, names)
+                    )
                     offs = np.r_[0, np.cumsum(widths)]
                     cols = []
                     for k, a in enumerate(args):

@@ -38,8 +38,11 @@ def test_sample_n(g, rng):
 
 
 def test_sample_e_chain(g, rng):
-    res = run_gql(g, "sampleE(1, 20).values(dense2).as(f)", rng=rng)
-    assert res["f"].shape == (20, 2)
+    # values after an edge step reads EDGE features (reference get_feature
+    # kernel with edge_ids)
+    res = run_gql(g, "sampleE(1, 20).values(e_dense).as(f)", rng=rng)
+    assert res["f"].shape == (20, 1)
+    assert (res["f"] > 0).all()
 
 
 def test_outv_order_limit(g):
@@ -231,3 +234,23 @@ def test_in_scalar_wraps(g):
     res = run_gql(g, "v([1, 2, 3]).has(blob, in_('1a')).get().as(x)")
     kept = {int(v) for v in res["x"] if int(v) != DEFAULT_ID}
     assert kept == {1}
+
+
+def test_values_on_edges(g):
+    """After e/sampleE/outE, values() reads EDGE features (the reference's
+    get_feature kernel accepts edge_ids [n,3])."""
+    res = run_gql(
+        g, "sampleE(0, 6).as(ed).values(e_dense).as(f)",
+        rng=np.random.default_rng(0),
+    )
+    edges = res["ed"]
+    want = g.get_edge_dense_feature(edges, ["e_dense"])
+    np.testing.assert_allclose(res["f"], want)
+    assert (res["f"] > 0).all()  # fixture e_dense = src + dst/10
+
+    # node values still work after traversing back to nodes
+    res = run_gql(
+        g, "sampleE(0, 4).as(ed).outV().as(nb).values(dense2).as(nf)",
+        rng=np.random.default_rng(1),
+    )
+    assert res["nf"].shape[1] == 2
