@@ -167,7 +167,11 @@ class Estimator:
         steps = total_steps if total_steps is not None else self.cfg.total_steps
         step_fn = self._train_step()
         t0 = time.time()
-        history = []
+        history = []  # on-device losses not yet drained to the host
+        fetched: list[float] = []
+        # drain in chunks: keeping one live device scalar per step for a
+        # long run pins an unbounded number of small device buffers
+        drain_every = 4096
         profiling = False
         for _ in range(steps):
             if (
@@ -198,6 +202,9 @@ class Estimator:
             # keep losses on device — a float() here would force a blocking
             # device→host round trip every step and serialize the pipeline
             history.append(loss)
+            if len(history) >= drain_every:
+                fetched.extend(np.asarray(jnp.stack(history)).tolist())
+                history = []
             if (
                 self.cfg.checkpoint_steps
                 and self.step % self.cfg.checkpoint_steps == 0
@@ -208,8 +215,10 @@ class Estimator:
             jax.profiler.stop_trace()
         if save:
             self.save()
-        # single batched fetch of all step losses (one transfer, not N)
-        return np.asarray(jnp.stack(history)).tolist() if history else []
+        # batched fetch of the remaining step losses (one transfer, not N)
+        if history:
+            fetched.extend(np.asarray(jnp.stack(history)).tolist())
+        return fetched
 
     def evaluate(self, batches: Iterable[tuple]) -> dict:
         self._ensure_init()

@@ -52,14 +52,23 @@ def partitioned_lookup(tables: list, ids):
     return out
 
 
-def partitioned_update(tables: list, ids, values, func=embedding_update):
+def partitioned_update(
+    tables: list, ids, values, func=embedding_update, momentum: float = 0.9
+):
     """Scatter `values` into mod-partitioned tables; returns new tables.
 
-    func is embedding_update or embedding_add (the reference's
-    tf.scatter_update / tf.scatter_add choice). Duplicate ids within one
-    call have undefined precedence (the reference's tf.scatter_update
-    shares that caveat).
+    func is embedding_update, embedding_add, or embedding_moving_average
+    (the reference's tf.scatter_update / tf.scatter_add choice; `momentum`
+    applies to the moving-average form only). Any other func is an error —
+    a silent fall-through to overwrite semantics would corrupt the table.
+    Duplicate ids within one call have undefined precedence (the
+    reference's tf.scatter_update shares that caveat).
     """
+    if func not in (embedding_update, embedding_add, embedding_moving_average):
+        raise ValueError(
+            "partitioned_update supports embedding_update / embedding_add /"
+            f" embedding_moving_average, got {func!r}"
+        )
     part, local = _mod_partition(ids, len(tables))
     out = []
     for p, t in enumerate(tables):
@@ -67,6 +76,11 @@ def partitioned_update(tables: list, ids, values, func=embedding_update):
         rows = jnp.where(sel, local, 0)
         if func is embedding_add:
             delta = jnp.where(sel[..., None], values, 0)
+        elif func is embedding_moving_average:
+            # new = m*old + (1-m)*v  →  delta = (1-m)*(v - old)
+            delta = jnp.where(
+                sel[..., None], (1.0 - momentum) * (values - t[rows]), 0
+            )
         else:
             # set as an add of (value - current): unselected ids collapse to
             # row 0 with delta 0, so scatter collisions there are harmless

@@ -364,8 +364,10 @@ class Query:
             elif fn == "label":
                 last = graph.node_type(cur)
             elif fn == "get":
+                cur_edges = None  # result is the node frontier
                 last = cur
             elif fn == "has_type":
+                cur_edges = None  # frontier moves back to nodes
                 keep = graph.node_type(cur) == int(args[0])
                 cur = np.where(keep, cur, DEFAULT_ID)
                 last = cur
@@ -381,13 +383,24 @@ class Query:
                     triples, w, mask = last
                     if triples.ndim == 3:  # outE
                         last = (triples[:n], w[:n], mask[:n])
+                        cur_edges = triples[:n].reshape(-1, 3)
                     else:
                         raise ValueError("limit after sampleLNB is undefined")
+                elif isinstance(last, np.ndarray) and last.ndim == 2 and (
+                    cur_edges is None
+                ):
+                    # sampleNWithTypes result [T, n]: limit per type so the
+                    # flattened frontier and the stored result stay aligned
+                    last = last[:, :n]
+                    cur = last.reshape(-1)
                 else:
                     cur = cur[:n]
                     if isinstance(last, np.ndarray):
                         last = last[:n]
+                    if cur_edges is not None:  # keep edge frontier in step
+                        cur_edges = cur_edges[:n]
             elif fn == "order_by":
+                cur_edges = None  # neighbor-step result: node frontier
                 if not (isinstance(last, tuple) and len(last) == 4):
                     raise ValueError("order_by follows a neighbor step")
                 nbr, w, tt, mask = last

@@ -254,3 +254,29 @@ def test_values_on_edges(g):
         rng=np.random.default_rng(1),
     )
     assert res["nf"].shape[1] == 2
+
+
+def test_limit_then_edge_values(g, rng):
+    # limit after an edge step must shrink the edge frontier too: a stale
+    # cur_edges would make values() read features for the untruncated set
+    res = run_gql(g, "sampleE(1, 20).limit(5).values(e_dense).as(f)", rng=rng)
+    assert res["f"].shape == (5, 1)
+
+
+def test_limit_after_out_e_edge_values(g):
+    res = run_gql(g, "v([1, 2, 3]).outE().limit(2).values(e_dense).as(f)")
+    triples = run_gql(g, "v([1, 2, 3]).outE().limit(2).as(e)")["e"][0]
+    # one feature row per (kept) edge slot of the truncated triples
+    assert res["f"].shape[0] == triples[:2].reshape(-1, 3).shape[0]
+
+
+def test_get_after_edge_step_reads_node_features(g, rng):
+    # get() moves the result back to the node frontier (edge dst); values()
+    # must then read NODE features, not leak the stale edge frontier
+    res = run_gql(g, "sampleE(1, 6).get().values(dense2).as(f)", rng=rng)
+    assert res["f"].shape == (6, 2)
+
+
+def test_limit_after_sample_n_with_types(g, rng):
+    res = run_gql(g, "sampleNWithTypes([0, 1], 5).limit(3).as(n)", rng=rng)
+    assert res["n"].shape == (2, 3)  # per-type truncation

@@ -258,3 +258,28 @@ def test_checkpoint_restores_opt_state(graph, tmp_path):
         if hasattr(x, "shape") and getattr(x, "size", 0) > 1
     ]
     assert any(v > 0 for v in nonzero), "optimizer slots were reset"
+
+
+def test_partitioned_update_moving_average_and_bad_func():
+    import pytest
+
+    from euler_tpu.nn import (
+        embedding_moving_average,
+        partitioned_lookup,
+        partitioned_update,
+    )
+
+    full = np.arange(12, dtype=np.float32).reshape(6, 2)
+    tables = [jnp.asarray(full[p::2]) for p in range(2)]
+    ids = jnp.asarray([1, 4])
+    vals = jnp.zeros((2, 2))
+    new = partitioned_update(
+        tables, ids, vals, func=embedding_moving_average, momentum=0.75
+    )
+    got = np.asarray(partitioned_lookup(new, jnp.arange(6)))
+    expect = full.copy()
+    expect[[1, 4]] *= 0.75  # m*old + (1-m)*0
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+    with pytest.raises(ValueError):
+        partitioned_update(tables, ids, vals, func=lambda t, i, v: t)
