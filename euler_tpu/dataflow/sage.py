@@ -62,16 +62,18 @@ class SageDataFlow(DataFlow):
                     for r in hop_rows
                 )
             elif self.feature_names and hasattr(
-                self.graph.shards[0], "get_dense_by_rows"
+                self.graph, "get_dense_by_rows"
             ):
                 # reuse the rows the fanout already resolved — no second
-                # per-id lookup pass
-                feats = tuple(
-                    self.graph.shards[0].get_dense_by_rows(
-                        r, self.feature_names
+                # per-id lookup pass (the facade splits global rows back to
+                # their owner shards on partitioned graphs)
+                try:
+                    feats = tuple(
+                        self.graph.get_dense_by_rows(r, self.feature_names)
+                        for r in hop_rows
                     )
-                    for r in hop_rows
-                )
+                except RuntimeError:  # e.g. remote shards without row access
+                    feats = tuple(self.node_feats(ids) for ids in hop_ids)
             else:
                 feats = tuple(self.node_feats(ids) for ids in hop_ids)
         else:

@@ -79,6 +79,19 @@ class RemoteShard:
         self.replicas = [_Replica(h, p) for h, p in replicas]
         self._rr = 0
         self._lock = threading.Lock()
+        self._num_nodes: int | None = None
+
+    @property
+    def part(self) -> int:
+        """Shard index — lets the Graph facade treat remote shards like
+        local ones for shard-major row arithmetic."""
+        return self.shard
+
+    @property
+    def num_nodes(self) -> int:
+        if self._num_nodes is None:
+            self._num_nodes = int(self.call("num_nodes", [])[0])
+        return self._num_nodes
 
     def add_replica(self, host: str, port: int):
         with self._lock:
@@ -179,9 +192,36 @@ class RemoteShard:
         )
         return _bool_mask(out, 2)
 
+    def fanout_with_rows(self, ids, edge_types, counts, rng=None):
+        """Fused multi-hop fanout in ONE client RPC (remote_op.cc:31-36
+        parity): the server coordinates the per-hop shard scatter next to
+        the data and returns every hop's ids/weights/types/masks plus
+        shard-major feature-cache rows."""
+        ids = np.asarray(ids, dtype=np.uint64)
+        counts = [int(c) for c in counts]
+        out = self.call(
+            "sample_fanout",
+            [ids, _types(edge_types), counts, _seed(rng)],
+        )
+        from euler_tpu.graph.store import split_hops
+
+        ids_h, w_h, tt_h, mask_h, rows_h = split_hops(len(ids), counts, *out)
+        return (
+            ids_h,
+            w_h,
+            tt_h,
+            [m.astype(bool) for m in mask_h],
+            rows_h,
+        )
+
     def get_dense_feature(self, ids, names):
         return self.call(
             "get_dense_feature", [np.asarray(ids, np.uint64), list(names)]
+        )[0]
+
+    def get_dense_by_rows(self, rows, names):
+        return self.call(
+            "get_dense_by_rows", [np.asarray(rows, np.int64), list(names)]
         )[0]
 
     def get_sparse_feature(self, ids, names, max_len=None):

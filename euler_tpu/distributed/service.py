@@ -80,6 +80,8 @@ class GraphService:
         self.registry = registry
         self._beat = None
         self._thread = None
+        self._cluster_g = None
+        self._cluster_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -100,6 +102,39 @@ class GraphService:
         self.server.shutdown()
         self.server.server_close()
 
+    # -- cluster facade (worker-to-worker fan-out) -----------------------
+
+    def _cluster(self):
+        """Graph facade over the whole cluster: this server's local store
+        plus RemoteShard clients to its peers — the worker-to-worker path
+        that lets one client RPC cover a multi-shard, multi-hop query
+        (the reference workers issue remote ops to peer shards the same
+        way, remote_op.cc:31-36)."""
+        with self._cluster_lock:
+            if self._cluster_g is None:
+                from euler_tpu.graph.store import Graph
+
+                num_parts = self.meta.num_partitions
+                if num_parts == 1:
+                    self._cluster_g = Graph(self.meta, [self.store])
+                else:
+                    if self.registry is None:
+                        raise RuntimeError(
+                            "multi-shard fan-out needs a registry so peers"
+                            " can be discovered"
+                        )
+                    from euler_tpu.distributed.client import RemoteShard
+
+                    cluster = self.registry.wait_for(num_parts)
+                    shards = []
+                    for idx in sorted(cluster):
+                        if idx == self.shard:
+                            shards.append(self.store)
+                        else:
+                            shards.append(RemoteShard(idx, cluster[idx]))
+                    self._cluster_g = Graph(self.meta, shards)
+            return self._cluster_g
+
     # -- dispatch --------------------------------------------------------
 
     def dispatch(self, op: str, a: list) -> list:
@@ -108,6 +143,22 @@ class GraphService:
             return [json.dumps(self.meta.to_dict())]
         if op == "ping":
             return [self.shard]
+        if op == "num_nodes":
+            return [int(s.num_nodes)]
+        if op == "sample_fanout":
+            res = self._cluster().fanout_with_rows(
+                a[0], a[1], a[2], _rng_from(a[3])
+            )
+            if res is None:
+                raise RuntimeError("fused fanout unsupported on this shard")
+            hop_ids, hop_w, hop_tt, hop_mask, hop_rows = res
+            return [
+                np.concatenate(hop_ids),
+                np.concatenate(hop_w),
+                np.concatenate(hop_tt),
+                np.concatenate(hop_mask).astype(np.uint8),
+                np.concatenate(hop_rows),
+            ]
         if op == "lookup":
             return [s.lookup(a[0])]
         if op == "node_type":
@@ -132,6 +183,11 @@ class GraphService:
             )
         if op == "get_dense_feature":
             return [s.get_dense_feature(a[0], a[1])]
+        if op == "get_dense_by_rows":
+            rows = np.asarray(a[0], dtype=np.int64)
+            if hasattr(s, "get_dense_by_rows"):
+                return [s.get_dense_by_rows(rows, a[1])]
+            return [s._dense_by_rows(rows, a[1], node=True)]
         if op == "get_sparse_feature":
             pairs = s.get_sparse_feature(a[0], a[1], a[2])
             return [x for pair in pairs for x in pair]

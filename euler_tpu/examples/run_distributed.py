@@ -59,12 +59,22 @@ def main(argv=None):
         print(f"connected to {args.shards} graph servers via {reg}")
 
         from euler_tpu.dataflow import SageDataFlow
-        from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+        from euler_tpu.estimator import (
+            DeviceFeatureCache,
+            Estimator,
+            EstimatorConfig,
+            node_batches,
+        )
         from euler_tpu.models import GraphSAGESupervised
 
         rng = np.random.default_rng(0)
+        # full hot path against the cluster: each batch is ONE fused-fanout
+        # RPC returning ids + shard-major rows; features stay device-side in
+        # the cache and the wire ships int32 rows only
+        cache = DeviceFeatureCache(remote, ["feat"])
         flow = SageDataFlow(
-            remote, ["feat"], fanouts=[5, 5], label_feature="label", rng=rng
+            remote, ["feat"], fanouts=[5, 5], label_feature="label", rng=rng,
+            feature_mode="rows",
         )
         model = GraphSAGESupervised(dims=[32, 32], label_dim=2)
         est = Estimator(
@@ -75,6 +85,7 @@ def main(argv=None):
                 total_steps=args.steps,
                 log_steps=max(args.steps // 5, 1),
             ),
+            feature_cache=cache,
         )
         est.train()
     finally:

@@ -272,14 +272,17 @@ class NativeGraphStore(GraphStore):
             tt_out.ctypes.data_as(ct.POINTER(ct.c_int32)),
             mask_out.ctypes.data_as(ct.POINTER(ct.c_uint8)),
         )
-        offs = np.r_[0, np.cumsum(widths)]
-        split = lambda a: [a[offs[i] : offs[i + 1]] for i in range(len(widths))]
+        from euler_tpu.graph.store import split_hops
+
+        ids_h, w_h, tt_h, mask_h, rows_h = split_hops(
+            n, counts, ids_out, w_out, tt_out, mask_out, rows_out
+        )
         return (
-            split(ids_out),
-            split(w_out),
-            split(tt_out),
-            [m.astype(bool) for m in split(mask_out)],
-            split(rows_out),
+            ids_h,
+            w_h,
+            tt_h,
+            [m.astype(bool) for m in mask_h],
+            rows_h,
         )
 
     def get_dense_by_rows(self, rows, names):

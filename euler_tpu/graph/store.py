@@ -39,6 +39,21 @@ def _fold_type(dnf, type_id: int):
     ]
 
 
+def split_hops(n_roots: int, counts, *arrays):
+    """Split flat per-kind arrays (concatenated over hops) into per-hop
+    lists: hop i holds n_roots * prod(counts[:i]) entries. Shared by the
+    native engine binding and the RPC client so both sides of the fused
+    fanout agree on the hop layout."""
+    widths = [int(n_roots)]
+    for c in counts:
+        widths.append(widths[-1] * int(c))
+    offs = np.r_[0, np.cumsum(widths)]
+    return [
+        [a[offs[i] : offs[i + 1]] for i in range(len(widths))]
+        for a in arrays
+    ]
+
+
 def _rng(rng) -> np.random.Generator:
     return rng if rng is not None else np.random.default_rng()
 
@@ -975,12 +990,90 @@ class Graph:
         )
 
     def fanout_with_rows(self, ids, edge_types, counts, rng=None):
-        """Fused multi-hop fanout incl. feature-cache rows, or None when
-        unsupported (multi-shard or non-native store). Single engine call
-        per batch — the hot path for sampled training."""
+        """Fused multi-hop fanout incl. feature-cache rows — the hot path
+        for sampled training. Returns (hop_ids, hop_w, hop_tt, hop_mask,
+        hop_rows) lists over hops 0..len(counts), or None when unsupported.
+
+        Three routes, mirroring the reference's shard-fanout optimizer
+        (optimizer.h:49-86, remote_op.cc:31-36 — keep multi-shard queries
+        one round per hop, and remote queries one client round trip):
+        - single local shard: one fused native-engine call;
+        - remote shards: ONE RPC to a coordinating server, which runs the
+          hop rounds next to the data (worker-to-worker scatter);
+        - multiple local shards: one owner-scattered round per hop, rows
+          globalized with per-shard offsets (shard-major row space).
+        Per-node sampling only reads that node's own out-edges (they live
+        wholly on its owner shard), so every route draws from the same
+        distribution.
+        """
+        rng = _rng(rng)
         if self.num_shards == 1 and hasattr(self.shards[0], "fanout_with_rows"):
             return self.shards[0].fanout_with_rows(ids, edge_types, counts, rng)
-        return None
+        if all(hasattr(s, "call") for s in self.shards):
+            # remote cluster: forward the whole query to one shard server
+            # (spread coordinator load across shards)
+            pick = int(rng.integers(self.num_shards))
+            try:
+                return self.shards[pick].fanout_with_rows(
+                    ids, edge_types, counts, rng
+                )
+            except RuntimeError:
+                # e.g. an older server without the sample_fanout op — keep
+                # the documented None-when-unsupported contract so callers
+                # fall back to the per-hop path
+                return None
+        try:
+            self._shard_row_offsets()  # capability check: rows resolvable?
+        except RuntimeError:
+            return None
+        ids = np.asarray(ids, dtype=np.uint64)
+        hop_ids = [ids]
+        hop_w = [np.ones(len(ids), np.float32)]
+        hop_tt = [np.asarray(self.node_type(ids), np.int32)]
+        hop_mask = [ids != DEFAULT_ID]
+        hop_rows = [np.asarray(self.lookup_rows(ids), np.int64)]
+        cur = ids
+        for c in counts:
+            nbr, w, tt, mask, _ = self.sample_neighbor(
+                cur, edge_types, int(c), rng=rng
+            )
+            cur = nbr.reshape(-1)
+            hop_ids.append(cur)
+            hop_w.append(w.reshape(-1).astype(np.float32))
+            hop_tt.append(tt.reshape(-1).astype(np.int32))
+            hop_mask.append(mask.reshape(-1))
+            hop_rows.append(np.asarray(self.lookup_rows(cur), np.int64))
+        return hop_ids, hop_w, hop_tt, hop_mask, hop_rows
+
+    def get_dense_by_rows(self, rows, names) -> np.ndarray:
+        """Dense features by pre-resolved global rows (-1 → zeros).
+
+        Rows are shard-major (lookup_rows space); multi-shard splits them
+        back to per-shard local rows, so the fused-fanout dense path works
+        on partitioned graphs too.
+        """
+        rows = np.asarray(rows, dtype=np.int64)
+        if self.num_shards == 1:
+            sh = self.shards[0]
+            if hasattr(sh, "get_dense_by_rows"):
+                return sh.get_dense_by_rows(rows, names)
+            return sh._dense_by_rows(rows, names, node=True)
+        offsets = self._shard_row_offsets()
+        owner = np.searchsorted(offsets, rows, side="right") - 1  # -1 → -1
+        dims = sum(
+            self.meta.feature_spec(nm, node=True).dim for nm in names
+        )
+        out = np.zeros((len(rows), dims), np.float32)
+        for s, sh in enumerate(self.shards):
+            sel = np.nonzero(owner == s)[0]
+            if not len(sel):
+                continue
+            local = rows[sel] - offsets[s]
+            if hasattr(sh, "get_dense_by_rows"):
+                out[sel] = sh.get_dense_by_rows(local, names)
+            else:
+                out[sel] = sh._dense_by_rows(local, names, node=True)
+        return out
 
     def sample_neighbor_layerwise(self, batch_ids, edge_types=None, count=128, rng=None):
         """Single-shard path for now; multi-shard merges candidate sets."""
@@ -1014,8 +1107,9 @@ class Graph:
     def _shard_row_offsets(self) -> np.ndarray:
         if not all(hasattr(s, "num_nodes") for s in self.shards):
             raise RuntimeError(
-                "feature-cache row lookup needs local shards; remote graphs "
-                "fetch features per batch (get_dense_feature)"
+                "feature-cache row lookup needs shards exposing num_nodes "
+                "(local stores, or remote shards served by a version with "
+                "the num_nodes op)"
             )
         return np.cumsum([0] + [s.num_nodes for s in self.shards])
 
@@ -1038,12 +1132,25 @@ class Graph:
         """f32 [total_nodes, F] dense features for all nodes, shard-major —
         the host-side source for a device feature cache (rows from
         lookup_rows index into it)."""
-        parts = [
-            sh._dense_by_rows(
-                np.arange(sh.num_nodes, dtype=np.int64), names, node=True
-            )
-            for sh in self.shards
-        ]
+        dims = max(
+            1,
+            sum(self.meta.feature_spec(nm, node=True).dim for nm in names),
+        )
+        # bound each fetch well under the wire frame cap so remote shards
+        # with big tables stream in chunks instead of one giant frame
+        chunk = max(1, (64 << 20) // (4 * dims))
+        parts = []
+        for sh in self.shards:
+            for lo in range(0, max(sh.num_nodes, 1), chunk):
+                rows = np.arange(
+                    lo, min(lo + chunk, sh.num_nodes), dtype=np.int64
+                )
+                if not len(rows):
+                    continue
+                if hasattr(sh, "get_dense_by_rows"):  # native or remote
+                    parts.append(sh.get_dense_by_rows(rows, names))
+                else:
+                    parts.append(sh._dense_by_rows(rows, names, node=True))
         return (
             np.concatenate(parts, axis=0)
             if parts

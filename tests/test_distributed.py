@@ -160,7 +160,94 @@ def test_remote_gql(cluster, rng):
     )
 
 
-def test_remote_feature_cache_guard(cluster):
+def test_remote_rows_and_feature_cache(cluster):
+    # remote shards expose num_nodes over the wire, so the shard-major row
+    # space (and therefore a device feature cache) works against a cluster
+    remote, local, *_ = cluster
+    rows = remote.lookup_rows(ALL_IDS)
+    np.testing.assert_array_equal(rows, local.lookup_rows(ALL_IDS))
+    np.testing.assert_allclose(
+        remote.dense_feature_table(["dense2"]),
+        local.dense_feature_table(["dense2"]),
+        rtol=1e-6,
+    )
+
+
+def test_remote_fused_fanout_one_rpc(cluster):
+    """The fused fanout reaches the cluster in ONE client RPC; the server
+    coordinates the per-hop shard scatter (remote_op.cc:31-36 parity)."""
+    from euler_tpu.distributed.client import RemoteShard
+
+    remote, local, *_ = cluster
+    rng = np.random.default_rng(3)
+    roots = np.asarray([1, 2, 3, 4], np.uint64)
+
+    calls = []
+    orig = RemoteShard.call
+    client_shards = {id(s) for s in remote.shards}
+
+    def counting(self, op, values):
+        # the in-process test services use RemoteShard for their own peer
+        # scatter; only count calls issued by the CLIENT's shards
+        if id(self) in client_shards:
+            calls.append(op)
+        return orig(self, op, values)
+
+    RemoteShard.call = counting
+    try:
+        res = remote.fanout_with_rows(roots, None, [3, 2], rng=rng)
+    finally:
+        RemoteShard.call = orig
+    assert res is not None
+    assert calls == ["sample_fanout"]  # one client RPC for the whole batch
+    hop_ids, hop_w, hop_tt, hop_mask, hop_rows = res
+    assert [len(h) for h in hop_ids] == [4, 12, 24]
+    np.testing.assert_array_equal(hop_ids[0], roots)
+    # rows are global shard-major and resolve to the right features
+    table = local.dense_feature_table(["dense2"])
+    for hop in range(3):
+        valid = hop_mask[hop] & (hop_rows[hop] >= 0)
+        assert valid.any()
+        np.testing.assert_allclose(
+            table[hop_rows[hop][valid]],
+            local.get_dense_feature(hop_ids[hop][valid], ["dense2"]),
+            rtol=1e-6,
+        )
+    # sampled neighbors are genuine out-neighbors
+    full, _, _, fmask, _ = local.get_full_neighbor(roots, None)
+    nbr1 = hop_ids[1].reshape(4, 3)
+    m1 = hop_mask[1].reshape(4, 3)
+    for i in range(4):
+        allowed = set(full[i][fmask[i]].tolist())
+        assert set(nbr1[i][m1[i]].tolist()) <= allowed
+
+
+def test_remote_rows_mode_training(cluster, tmp_path):
+    """Rows-mode SageDataFlow + device feature cache against the cluster:
+    the wire carries int32 rows, features live device-side."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import (
+        DeviceFeatureCache,
+        Estimator,
+        EstimatorConfig,
+        node_batches,
+    )
+    from euler_tpu.nn import SuperviseModel
+
     remote, *_ = cluster
-    with pytest.raises(RuntimeError, match="local shards"):
-        remote.lookup_rows(ALL_IDS)
+    rng = np.random.default_rng(0)
+    cache = DeviceFeatureCache(remote, ["dense2"])
+    flow = SageDataFlow(
+        remote, ["dense2"], fanouts=[2], label_feature="dense3", rng=rng,
+        feature_mode="rows",
+    )
+    model = SuperviseModel(conv="sage", dims=[8], label_dim=3)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "rrows"), total_steps=3, log_steps=10**9
+    )
+    est = Estimator(
+        model, node_batches(remote, flow, 4, rng=rng), cfg,
+        feature_cache=cache,
+    )
+    hist = est.train(save=False)
+    assert np.isfinite(hist).all()

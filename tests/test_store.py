@@ -176,3 +176,67 @@ def test_layerwise(graph1, rng):
     # adjacency only points at sampled layer nodes
     assert (adj[:, ~mask] == 0).all()
     assert adj.sum() > 0
+
+
+class TestMultiShardFusedFanout:
+    """Graph.fanout_with_rows on partitioned graphs: one owner-scattered
+    round per hop, shard-major global rows (reference optimizer parity,
+    optimizer.h:49-86)."""
+
+    def test_shapes_rows_and_features(self, graph2):
+        g = graph2
+        rng = np.random.default_rng(0)
+        roots = np.asarray([1, 2, 3, 4], np.uint64)
+        res = g.fanout_with_rows(roots, None, [3, 2], rng=rng)
+        assert res is not None
+        hop_ids, hop_w, hop_tt, hop_mask, hop_rows = res
+        assert [len(h) for h in hop_ids] == [4, 12, 24]
+        np.testing.assert_array_equal(hop_ids[0], roots)
+        # global rows point at the right dense_feature_table entries
+        table = g.dense_feature_table(["dense2"])
+        for hop in range(3):
+            valid = hop_mask[hop] & (hop_rows[hop] >= 0)
+            assert valid.any()
+            np.testing.assert_allclose(
+                table[hop_rows[hop][valid]],
+                g.get_dense_feature(hop_ids[hop][valid], ["dense2"]),
+                rtol=1e-6,
+            )
+
+    def test_matches_single_shard_distribution(self, graph1, graph2):
+        # per-node sampling reads only that node's own out-edges, so the
+        # sharded route must draw from the same distribution
+        reps = 400
+        roots = np.asarray([1, 3, 5], np.uint64)
+        counts = {}
+        for name, g in (("p1", graph1), ("p2", graph2)):
+            rng = np.random.default_rng(7)
+            freq = {}
+            for _ in range(reps):
+                hop_ids, _, _, hop_mask, _ = g.fanout_with_rows(
+                    roots, None, [4], rng=rng
+                )
+                nbr = hop_ids[1].reshape(3, 4)
+                for i in range(3):
+                    for v in nbr[i][hop_mask[1].reshape(3, 4)[i]]:
+                        freq[(i, int(v))] = freq.get((i, int(v)), 0) + 1
+            counts[name] = freq
+        assert set(counts["p1"]) == set(counts["p2"])  # same support
+        total = reps * 4
+        for key in counts["p1"]:
+            a = counts["p1"][key] / total
+            b = counts["p2"][key] / total
+            assert abs(a - b) < 0.08, (key, a, b)
+
+    def test_dense_by_rows_multi_shard(self, graph2):
+        g = graph2
+        ids = np.asarray([1, 2, 3, 4, 5, 6], np.uint64)
+        rows = g.lookup_rows(ids)
+        assert (rows >= 0).all()
+        got = g.get_dense_by_rows(rows, ["dense2", "dense3"])
+        np.testing.assert_allclose(
+            got, g.get_dense_feature(ids, ["dense2", "dense3"]), rtol=1e-6
+        )
+        # -1 rows yield zero features
+        got = g.get_dense_by_rows(np.asarray([-1, rows[0]]), ["dense2"])
+        assert (got[0] == 0).all()
