@@ -72,8 +72,15 @@ class SageDataFlow(DataFlow):
                         self.graph.get_dense_by_rows(r, self.feature_names)
                         for r in hop_rows
                     )
-                except RuntimeError:  # e.g. remote shards without row access
-                    feats = tuple(self.node_feats(ids) for ids in hop_ids)
+                except RuntimeError as e:
+                    # capability gap only (older server / no row space):
+                    # fall back to per-id fetch; real failures must surface
+                    if "unknown op" in str(e) or "num_nodes" in str(e):
+                        feats = tuple(
+                            self.node_feats(ids) for ids in hop_ids
+                        )
+                    else:
+                        raise
             else:
                 feats = tuple(self.node_feats(ids) for ids in hop_ids)
         else:

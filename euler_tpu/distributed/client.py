@@ -68,6 +68,22 @@ class _Replica:
         return result
 
 
+class _RemoteCondition:
+    """Client-side handle for a server-side DNF index search.
+
+    The reference keeps IndexResult sets on the serving shard and ships
+    only what the client round needs (sample_index.h:49-60); here the
+    handle carries the DNF (re-evaluated server-side per call — index
+    lookups are hash/range probes, cheap) plus the matched weight used by
+    the shard-weighted conditioned root draw.
+    """
+
+    def __init__(self, dnf_json: str, node: bool, total_weight: float):
+        self.dnf_json = dnf_json
+        self.node = node
+        self.total_weight = total_weight
+
+
 class RemoteShard:
     """GraphStore-compatible view of one shard served by N replicas."""
 
@@ -192,6 +208,49 @@ class RemoteShard:
         )
         return _bool_mask(out, 2)
 
+    # -- index/condition surface (remote GQL has() etc.) -----------------
+
+    def search_condition(self, dnf, node: bool = True) -> _RemoteCondition:
+        dnf_json = _dnf_json(dnf)
+        w = float(self.call("condition_weight", [dnf_json, bool(node)])[0])
+        return _RemoteCondition(dnf_json, node, w)
+
+    def sample_from_result(self, res: _RemoteCondition, count: int, rng=None):
+        return self.call(
+            "sample_node_with_condition",
+            [int(count), res.dnf_json, -1, _seed(rng)],
+        )[0]
+
+    def sample_edges_from_result(
+        self, res: _RemoteCondition, count: int, rng=None
+    ):
+        return self.call(
+            "sample_edge_with_condition",
+            [int(count), res.dnf_json, -1, _seed(rng)],
+        )[0]
+
+    def sample_node_with_condition(self, count, dnf, node_type=-1, rng=None):
+        return self.call(
+            "sample_node_with_condition",
+            [int(count), _dnf_json(dnf), node_type, _seed(rng)],
+        )[0]
+
+    def sample_edge_with_condition(self, count, dnf, edge_type=-1, rng=None):
+        return self.call(
+            "sample_edge_with_condition",
+            [int(count), _dnf_json(dnf), edge_type, _seed(rng)],
+        )[0]
+
+    def condition_mask(self, ids, dnf, node: bool = True):
+        ids = np.asarray(ids, dtype=np.uint64)
+        out = self.call(
+            "condition_mask", [ids, _dnf_json(dnf), bool(node)]
+        )[0]
+        return out.astype(bool)
+
+    def get_node_ids_by_condition(self, dnf):
+        return self.call("node_ids_by_condition", [_dnf_json(dnf)])[0]
+
     def fanout_with_rows(self, ids, edge_types, counts, rng=None):
         """Fused multi-hop fanout in ONE client RPC (remote_op.cc:31-36
         parity): the server coordinates the per-hop shard scatter next to
@@ -282,6 +341,17 @@ class RemoteShard:
                 _seed(rng),
             ],
         )[0]
+
+
+def _dnf_json(dnf) -> str:
+    """Serialize a DNF condition ([[ (field, op, value), ...], ...]) to
+    JSON for the wire; numpy scalars become plain Python values."""
+    if dnf is None:
+        return json.dumps(None)
+    clean = lambda v: v.item() if hasattr(v, "item") else v
+    return json.dumps(
+        [[[f, o, clean(v)] for f, o, v in clause] for clause in dnf]
+    )
 
 
 def _types(edge_types):

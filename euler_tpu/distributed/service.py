@@ -184,10 +184,7 @@ class GraphService:
         if op == "get_dense_feature":
             return [s.get_dense_feature(a[0], a[1])]
         if op == "get_dense_by_rows":
-            rows = np.asarray(a[0], dtype=np.int64)
-            if hasattr(s, "get_dense_by_rows"):
-                return [s.get_dense_by_rows(rows, a[1])]
-            return [s._dense_by_rows(rows, a[1], node=True)]
+            return [s.get_dense_by_rows(np.asarray(a[0], np.int64), a[1])]
         if op == "get_sparse_feature":
             pairs = s.get_sparse_feature(a[0], a[1], a[2])
             return [x for pair in pairs for x in pair]
@@ -205,6 +202,33 @@ class GraphService:
             return [s.get_edge_dense_feature(a[0], a[1])]
         if op == "get_graph_by_label":
             return [list(s.get_graph_by_label(a[0]))]
+        if op == "condition_weight":
+            # DNF conditions ride the wire as JSON (values are plain
+            # str/int/float); the matched weight lets the client run the
+            # shard-weighted conditioned root draw (index pushdown parity,
+            # compiler.h:37-41)
+            res = s.search_condition(json.loads(a[0]), node=a[1])
+            return [float(res.total_weight)]
+        if op == "sample_node_with_condition":
+            return [
+                s.sample_node_with_condition(
+                    a[0], json.loads(a[1]), a[2], _rng_from(a[3])
+                )
+            ]
+        if op == "sample_edge_with_condition":
+            return [
+                s.sample_edge_with_condition(
+                    a[0], json.loads(a[1]), a[2], _rng_from(a[3])
+                )
+            ]
+        if op == "condition_mask":
+            return [
+                s.condition_mask(a[0], json.loads(a[1]), node=a[2]).astype(
+                    np.uint8
+                )
+            ]
+        if op == "node_ids_by_condition":
+            return [s.get_node_ids_by_condition(json.loads(a[0]))]
         if op == "random_walk":
             return [s.random_walk(a[0], a[1], a[2], a[3], a[4], _rng_from(a[5]))]
         if op == "node2vec_step":

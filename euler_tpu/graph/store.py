@@ -438,6 +438,13 @@ class GraphStore:
         rows = self.lookup(ids)
         return self._dense_by_rows(rows, names, node=True)
 
+    def get_dense_by_rows(self, rows, names) -> np.ndarray:
+        """Dense node features by pre-resolved local rows (-1 → zeros);
+        skips the id lookup. Same contract as the native engine's."""
+        return self._dense_by_rows(
+            np.asarray(rows, dtype=np.int64), names, node=True
+        )
+
     def _dense_by_rows(self, rows, names, node: bool) -> np.ndarray:
         prefix = "nf" if node else "ef"
         specs = [self.meta.feature_spec(nm, node=node) for nm in names]
@@ -1000,8 +1007,10 @@ class Graph:
         - single local shard: one fused native-engine call;
         - remote shards: ONE RPC to a coordinating server, which runs the
           hop rounds next to the data (worker-to-worker scatter);
-        - multiple local shards: one owner-scattered round per hop, rows
-          globalized with per-shard offsets (shard-major row space).
+        - multiple local shards: one owner-scattered sampling round per
+          hop, then a single batched row-resolve round over every hop's
+          ids, rows globalized with per-shard offsets (shard-major row
+          space) — len(counts)+2 scatter rounds total per batch.
         Per-node sampling only reads that node's own out-edges (they live
         wholly on its owner shard), so every route draws from the same
         distribution.
@@ -1017,11 +1026,13 @@ class Graph:
                 return self.shards[pick].fanout_with_rows(
                     ids, edge_types, counts, rng
                 )
-            except RuntimeError:
-                # e.g. an older server without the sample_fanout op — keep
-                # the documented None-when-unsupported contract so callers
-                # fall back to the per-hop path
-                return None
+            except RuntimeError as e:
+                if "unknown op" in str(e):
+                    # older server without the sample_fanout op — keep the
+                    # documented None-when-unsupported contract so callers
+                    # fall back to the per-hop path
+                    return None
+                raise  # genuine server/network failure: surface it
         try:
             self._shard_row_offsets()  # capability check: rows resolvable?
         except RuntimeError:
@@ -1031,7 +1042,6 @@ class Graph:
         hop_w = [np.ones(len(ids), np.float32)]
         hop_tt = [np.asarray(self.node_type(ids), np.int32)]
         hop_mask = [ids != DEFAULT_ID]
-        hop_rows = [np.asarray(self.lookup_rows(ids), np.int64)]
         cur = ids
         for c in counts:
             nbr, w, tt, mask, _ = self.sample_neighbor(
@@ -1042,7 +1052,16 @@ class Graph:
             hop_w.append(w.reshape(-1).astype(np.float32))
             hop_tt.append(tt.reshape(-1).astype(np.int32))
             hop_mask.append(mask.reshape(-1))
-            hop_rows.append(np.asarray(self.lookup_rows(cur), np.int64))
+        # one batched row-resolve round for ALL hops (each hop's rows live
+        # on the id's owner shard, not the sampling shard, so they can't
+        # ride the sampling round — but they can share one scatter)
+        all_rows = np.asarray(
+            self.lookup_rows(np.concatenate(hop_ids)), np.int64
+        )
+        offs = np.r_[0, np.cumsum([len(h) for h in hop_ids])]
+        hop_rows = [
+            all_rows[offs[i] : offs[i + 1]] for i in range(len(hop_ids))
+        ]
         return hop_ids, hop_w, hop_tt, hop_mask, hop_rows
 
     def get_dense_by_rows(self, rows, names) -> np.ndarray:
@@ -1054,10 +1073,7 @@ class Graph:
         """
         rows = np.asarray(rows, dtype=np.int64)
         if self.num_shards == 1:
-            sh = self.shards[0]
-            if hasattr(sh, "get_dense_by_rows"):
-                return sh.get_dense_by_rows(rows, names)
-            return sh._dense_by_rows(rows, names, node=True)
+            return self.shards[0].get_dense_by_rows(rows, names)
         offsets = self._shard_row_offsets()
         owner = np.searchsorted(offsets, rows, side="right") - 1  # -1 → -1
         dims = sum(
@@ -1069,10 +1085,7 @@ class Graph:
             if not len(sel):
                 continue
             local = rows[sel] - offsets[s]
-            if hasattr(sh, "get_dense_by_rows"):
-                out[sel] = sh.get_dense_by_rows(local, names)
-            else:
-                out[sel] = sh._dense_by_rows(local, names, node=True)
+            out[sel] = sh.get_dense_by_rows(local, names)
         return out
 
     def sample_neighbor_layerwise(self, batch_ids, edge_types=None, count=128, rng=None):
@@ -1147,10 +1160,7 @@ class Graph:
                 )
                 if not len(rows):
                     continue
-                if hasattr(sh, "get_dense_by_rows"):  # native or remote
-                    parts.append(sh.get_dense_by_rows(rows, names))
-                else:
-                    parts.append(sh._dense_by_rows(rows, names, node=True))
+                parts.append(sh.get_dense_by_rows(rows, names))
         return (
             np.concatenate(parts, axis=0)
             if parts

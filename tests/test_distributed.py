@@ -251,3 +251,55 @@ def test_remote_rows_mode_training(cluster, tmp_path):
     )
     hist = est.train(save=False)
     assert np.isfinite(hist).all()
+
+
+def test_remote_condition_ops(cluster, rng):
+    """DNF index/condition surface over the wire (index pushdown parity,
+    compiler.h:37-41): masks, conditioned sampling, id scans."""
+    remote, local, *_ = cluster
+    dnf = [[("dense2", "gt", 3.0)]]
+    np.testing.assert_array_equal(
+        remote.condition_mask(ALL_IDS, dnf), local.condition_mask(ALL_IDS, dnf)
+    )
+    ids = remote.sample_node_with_condition(64, dnf, rng=rng)
+    valid = ids != np.uint64(0xFFFFFFFFFFFFFFFF)
+    assert valid.all()
+    assert local.condition_mask(ids, dnf).all()
+    np.testing.assert_array_equal(
+        remote.get_node_ids_by_condition(dnf),
+        local.get_node_ids_by_condition(dnf),
+    )
+    ednf = [[("e_dense", "gt", 4.0)]]
+    edges = remote.sample_edge_with_condition(32, ednf, rng=rng)
+    assert local.condition_mask(edges, ednf, node=False).all()
+
+
+def test_remote_gql_conditions(cluster, rng):
+    """GQL has()/DNF chains against the remote cluster."""
+    from euler_tpu.query import run_gql
+
+    remote, local, *_ = cluster
+    res = run_gql(
+        remote, "v(roots).has(dense2, gt(3)).as(kept)", {"roots": ALL_IDS},
+        rng=rng,
+    )
+    kept = res["kept"]
+    expect = np.where(
+        local.condition_mask(ALL_IDS, [[("dense2", "gt", 3.0)]]),
+        ALL_IDS,
+        np.uint64(0xFFFFFFFFFFFFFFFF),
+    )
+    np.testing.assert_array_equal(kept, expect)
+    # conditioned sampling step
+    res = run_gql(remote, "sampleN(0, 16).has(dense2, gt(2)).as(n)", rng=rng)
+    valid = res["n"] != np.uint64(0xFFFFFFFFFFFFFFFF)
+    assert valid.any()
+    assert local.condition_mask(res["n"][valid], [[("dense2", "gt", 2.0)]]).all()
+    # nb_filter semantics through a neighbor step
+    res = run_gql(
+        remote, "v(roots).outV().has(dense2, gt(3)).as(nb)",
+        {"roots": ALL_IDS}, rng=rng,
+    )
+    nbr, w, tt, mask = res["nb"]
+    if mask.any():
+        assert local.condition_mask(nbr[mask], [[("dense2", "gt", 3.0)]]).all()
