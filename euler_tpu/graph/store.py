@@ -124,21 +124,27 @@ class _CSR:
         return out
 
 
-def _searchsorted_segments(sorted_vals, seg_start, seg_end, targets):
-    """For each i, position of targets[i] within sorted_vals[seg_start:seg_end]."""
+def _searchsorted_segments(sorted_vals, seg_start, seg_end, targets, side="left"):
+    """For each i, insertion position of targets[i] within the sorted slice
+    sorted_vals[seg_start[i]:seg_end[i]] (vectorized per-segment binary
+    search; side as in np.searchsorted)."""
     n = len(targets)
-    lo = seg_start.copy()
-    hi = seg_end.copy()
+    lo = np.asarray(seg_start).copy()
+    hi = np.asarray(seg_end).copy()
+    right = side == "right"
     while True:
         active = lo < hi
         if not active.any():
             break
         mid = (lo + hi) // 2
         less = np.zeros(n, dtype=bool)
-        less[active] = sorted_vals[mid[active]] < targets[active]
+        if right:
+            less[active] = sorted_vals[mid[active]] <= targets[active]
+        else:
+            less[active] = sorted_vals[mid[active]] < targets[active]
         lo = np.where(active & less, mid + 1, lo)
         hi = np.where(active & ~less, mid, hi)
-    return lo - seg_start
+    return lo - np.asarray(seg_start)
 
 
 class GraphStore:
@@ -190,7 +196,7 @@ class GraphStore:
             for t in range(meta.num_edge_types)
         ]
         self._edge_sampler_all = _WeightedSampler(self.edge_weights)
-        self._edge_key_index: dict | None = None
+        self._edge_key_index: tuple | None = None  # lexsorted (src,dst,type)
         self._index_mgr = None
         self._edge_index_mgr = None
 
@@ -488,35 +494,68 @@ class GraphStore:
             safe = np.maximum(rows, 0)
             lens = np.where(rows >= 0, indptr[safe + 1] - indptr[safe], 0)
             cap = int(max_len) if max_len else max(int(lens.max(initial=0)), 1)
-            vals = np.zeros((len(rows), cap), dtype=values.dtype)
-            mask = np.zeros((len(rows), cap), dtype=bool)
-            for i, r in enumerate(rows):
-                if r < 0:
-                    continue
-                seg = values[indptr[r] : indptr[r + 1]][:cap]
-                vals[i, : len(seg)] = seg
-                mask[i, : len(seg)] = True
+            if len(values) == 0:  # feature declared but empty everywhere
+                out.append(
+                    (
+                        np.zeros((len(rows), cap), dtype=values.dtype),
+                        np.zeros((len(rows), cap), dtype=bool),
+                    )
+                )
+                continue
+            # vectorized ragged gather: slot j of row i reads
+            # values[indptr[row]+j] while j < len(row)
+            j = np.arange(cap)
+            mask = j[None, :] < np.minimum(lens, cap)[:, None]
+            idx = indptr[safe][:, None] + j[None, :]
+            np.clip(idx, 0, len(values) - 1, out=idx)
+            vals = np.where(
+                mask, np.asarray(values)[idx], np.zeros((), values.dtype)
+            )
             out.append((vals, mask))
         return out
 
     # ---- edge features -------------------------------------------------
 
     def _edge_rows(self, edge_ids: np.ndarray) -> np.ndarray:
-        """(src,dst,type) triples [n,3] u64 → edge row indices, -1 missing."""
+        """(src,dst,type) triples [n,3] u64 → edge row indices, -1 missing.
+
+        Backed by a lazily-built (src,dst,type)-lexsorted permutation +
+        vectorized segmented binary search: O(E log E) numpy sort once,
+        O(n log E) per query batch — no Python dict over every edge
+        (node.h:49-57 keeps per-node sorted adjacency for the same reason;
+        parallel duplicate triples resolve to one of their rows).
+        """
         if self._edge_key_index is None:
-            self._edge_key_index = {
-                (int(s), int(d), int(t)): i
-                for i, (s, d, t) in enumerate(
-                    zip(self.edge_src, self.edge_dst, self.edge_types)
-                )
-            }
-        return np.asarray(
-            [
-                self._edge_key_index.get((int(s), int(d), int(t)), -1)
-                for s, d, t in np.asarray(edge_ids, dtype=np.uint64)
-            ],
-            dtype=np.int64,
+            order = np.lexsort(
+                (self.edge_types, self.edge_dst, self.edge_src)
+            ).astype(np.int64)
+            self._edge_key_index = (
+                order,
+                np.ascontiguousarray(self.edge_src[order]),
+                np.ascontiguousarray(self.edge_dst[order]),
+                np.ascontiguousarray(self.edge_types[order]),
+            )
+        order, s_src, s_dst, s_typ = self._edge_key_index
+        q = np.asarray(edge_ids, dtype=np.uint64).reshape(-1, 3)
+        if len(order) == 0:  # edge-less shard: nothing can match
+            return np.full(len(q), -1, dtype=np.int64)
+        qs, qd = q[:, 0], q[:, 1]
+        qt = q[:, 2].astype(s_typ.dtype)
+        # narrow [lo, hi) three levels deep: src, then dst, then type
+        lo = np.searchsorted(s_src, qs, side="left")
+        hi = np.searchsorted(s_src, qs, side="right")
+        lo2 = lo + _searchsorted_segments(s_dst, lo, hi, qd, side="left")
+        hi2 = lo + _searchsorted_segments(s_dst, lo, hi, qd, side="right")
+        pos = lo2 + _searchsorted_segments(s_typ, lo2, hi2, qt, side="left")
+        safe = np.minimum(pos, max(len(order) - 1, 0))
+        hit = (
+            (pos < hi2)
+            & (len(order) > 0)
+            & (s_typ[safe] == qt)
+            & (s_dst[safe] == qd)
+            & (s_src[safe] == qs)
         )
+        return np.where(hit, order[safe], -1)
 
     def get_edge_dense_feature(self, edge_ids, names: list[str]) -> np.ndarray:
         rows = self._edge_rows(edge_ids)

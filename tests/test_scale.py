@@ -1,0 +1,91 @@
+"""Scale guards: hot store paths must stay vectorized (no per-edge Python).
+
+A ~5M-edge synthetic shard is built array-direct; the budgets are generous
+for slow CI but catch O(E)-per-query or per-row-Python regressions, which
+blow past them by orders of magnitude (VERDICT round 1: dict over every
+edge was fatal at the 1B-edge north star)."""
+
+import time
+
+import numpy as np
+
+from euler_tpu.datasets.synthetic import random_graph
+
+
+def test_edge_rows_scale_vectorized():
+    g = random_graph(num_nodes=400_000, out_degree=12, feat_dim=4, seed=1)
+    st = g.shards[0]
+    assert len(st.edge_src) == 4_800_000
+    idx = np.linspace(0, len(st.edge_src) - 1, 20_000).astype(np.int64)
+    triples = np.stack(
+        [st.edge_src[idx], st.edge_dst[idx], st.edge_types[idx].astype(np.uint64)],
+        axis=1,
+    )
+    t0 = time.perf_counter()
+    rows = st._edge_rows(triples)  # includes the one-off lexsort build
+    build_and_query_s = time.perf_counter() - t0
+    assert (rows >= 0).all()
+    # resolved rows hold the queried triples (duplicates may resolve to a
+    # different parallel edge row, which is fine — same key)
+    np.testing.assert_array_equal(st.edge_src[rows], triples[:, 0])
+    np.testing.assert_array_equal(st.edge_dst[rows], triples[:, 1])
+    np.testing.assert_array_equal(
+        st.edge_types[rows].astype(np.uint64), triples[:, 2]
+    )
+    t0 = time.perf_counter()
+    st._edge_rows(triples)
+    query_s = time.perf_counter() - t0
+    # budgets: a per-edge Python pass is minutes; vectorized is well under
+    assert build_and_query_s < 30.0, build_and_query_s
+    assert query_s < 5.0, query_s
+    # misses return -1
+    bad = triples.copy()
+    bad[:, 2] = np.uint64(7)
+    assert (st._edge_rows(bad) == -1).all()
+
+
+def test_dense_feature_scale():
+    g = random_graph(num_nodes=300_000, out_degree=10, feat_dim=8, seed=2)
+    st = g.shards[0]
+    ids = st.node_ids[:: max(len(st.node_ids) // 50_000, 1)]
+    t0 = time.perf_counter()
+    f = st.get_dense_feature(ids, ["feat"])
+    dt = time.perf_counter() - t0
+    assert f.shape == (len(ids), 8)
+    assert dt < 5.0, dt
+
+
+def test_empty_edge_shard_and_empty_sparse_values():
+    # edge-less shard: every triple misses; empty sparse values: zero mask
+    from euler_tpu.graph.meta import FeatureSpec, GraphMeta
+    from euler_tpu.graph.store import GraphStore
+
+    meta = GraphMeta(
+        name="empty",
+        num_partitions=1,
+        num_node_types=1,
+        num_edge_types=1,
+        node_features={"sp": FeatureSpec("sp", "sparse", 0, 2)},
+        edge_features={},
+    )
+    n = 3
+    arrays = {
+        "node_ids": np.asarray([1, 2, 3], np.uint64),
+        "node_types": np.zeros(n, np.int32),
+        "node_weights": np.ones(n, np.float32),
+        "edge_src": np.zeros(0, np.uint64),
+        "edge_dst": np.zeros(0, np.uint64),
+        "edge_types": np.zeros(0, np.int32),
+        "edge_weights": np.zeros(0, np.float32),
+        "adj_0_indptr": np.zeros(n + 1, np.int64),
+        "adj_0_dst": np.zeros(0, np.uint64),
+        "adj_0_w": np.zeros(0, np.float32),
+        "adj_0_eidx": np.zeros(0, np.int64),
+        "nf_sparse_0_indptr": np.zeros(n + 1, np.int64),
+        "nf_sparse_0_values": np.zeros(0, np.uint64),
+    }
+    st = GraphStore(meta, arrays)
+    rows = st._edge_rows(np.asarray([[1, 2, 0]], np.uint64))
+    assert (rows == -1).all()
+    vals, mask = st.get_sparse_feature(np.asarray([1, 2], np.uint64), ["sp"])[0]
+    assert vals.shape == (2, 1) and not mask.any()
