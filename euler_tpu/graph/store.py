@@ -550,7 +550,6 @@ class GraphStore:
         safe = np.minimum(pos, max(len(order) - 1, 0))
         hit = (
             (pos < hi2)
-            & (len(order) > 0)
             & (s_typ[safe] == qt)
             & (s_dst[safe] == qd)
             & (s_src[safe] == qs)

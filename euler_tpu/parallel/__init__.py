@@ -9,4 +9,5 @@ from euler_tpu.parallel.mesh import (  # noqa: F401
     shard_params,
     unbox_and_shard,
 )
+from euler_tpu.parallel import multihost  # noqa: F401
 from euler_tpu.parallel.sp import sp_segment_mean, sp_segment_sum  # noqa: F401

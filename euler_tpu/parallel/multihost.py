@@ -1,0 +1,129 @@
+"""Multi-host data parallelism — SPMD across processes.
+
+The reference deploys one TF worker per host with parameter servers
+(scripts/dist_tf_euler.sh:28-43, TF_CONFIG worker/ps roles); the TPU-native
+equivalent is single-program multiple-data: every host runs the SAME jitted
+step over a global device mesh, feeds the process-local slice of the global
+batch, and XLA all-reduces gradients over ICI (intra-pod) / DCN (cross-pod)
+from the shardings alone — no parameter servers, no hand-written collectives.
+
+Flow: `initialize()` once per process → `data_mesh()` over the global
+devices → build the LOCAL slice of each batch with any grid dataflow →
+`put_global()` to assemble global sharded arrays. Grid blocks' edge indices
+are rebuilt on device from global iota (`hydrate_blocks`), so hosts never
+have to agree on index offsets.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from euler_tpu.dataflow.base import MiniBatch
+
+DATA_AXIS = "data"
+
+
+def initialize(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host cluster; returns True when multi-process.
+
+    Arguments fall back to EULER_COORDINATOR / EULER_NUM_PROCESSES /
+    EULER_PROCESS_ID (the dist_tf_euler.sh-style launcher contract). A
+    single-process caller (no coordinator configured) is a no-op, so the
+    same training script runs unchanged on one host.
+    """
+    coordinator = coordinator or os.environ.get("EULER_COORDINATOR")
+    if num_processes is None and "EULER_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["EULER_NUM_PROCESSES"])
+    if process_id is None and "EULER_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["EULER_PROCESS_ID"])
+    if coordinator is None or not num_processes or num_processes <= 1:
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator, num_processes=num_processes, process_id=process_id
+        )
+    except RuntimeError as e:  # tolerate repeat calls in one process
+        if "already" not in str(e).lower():
+            raise
+    return True
+
+
+def data_mesh(devices=None) -> Mesh:
+    """1-D ('data',) mesh over every device of every process."""
+    devs = np.array(list(devices if devices is not None else jax.devices()))
+    return Mesh(devs, (DATA_AXIS,))
+
+
+def _globalize_blocks(mb: MiniBatch, pc: int) -> MiniBatch:
+    """Rescale static block sizes local→global and drop host-built edge ids.
+
+    Grid blocks (dst row i owns src slots [i*g, (i+1)*g)) keep their
+    structure under process-major concatenation, and `hydrate_blocks`
+    rebuilds edge_src/edge_dst from GLOBAL iota inside the jitted step —
+    host-local index arrays would point into the wrong global rows.
+    """
+    blocks = []
+    for b in mb.blocks:
+        if not b.grid:
+            raise ValueError(
+                "multi-host batches need grid-structured blocks (sampled "
+                "fanout / full-neighbor flows); irregular blocks would "
+                "carry host-local indices into the global program"
+            )
+        blocks.append(
+            b.replace(
+                edge_src=None,
+                edge_dst=None,
+                n_src=b.n_src * pc,
+                n_dst=b.n_dst * pc,
+            )
+        )
+    return mb.replace(blocks=tuple(blocks))
+
+
+def put_global(mesh: Mesh, tree):
+    """Assemble per-process local batch slices into global sharded arrays.
+
+    Every array leaf is the process-LOCAL slice; leaves stack process-major
+    along their leading axis into a global array sharded over the data
+    axis. MiniBatch blocks are globalized (see _globalize_blocks). Leading
+    dims must divide evenly over the local devices — silent replication of
+    per-host-different data would corrupt the batch, so it is an error.
+    """
+    pc = jax.process_count()
+    per_proc = mesh.shape[DATA_AXIS] // pc
+    shd = NamedSharding(mesh, P(DATA_AXIS))
+
+    def put(x):
+        x = np.asarray(x)
+        if x.ndim == 0 or x.shape[0] % per_proc != 0:
+            raise ValueError(
+                f"leaf shape {x.shape} does not shard over {per_proc} local"
+                f" devices; pad the per-host batch"
+            )
+        return jax.make_array_from_process_local_data(shd, x)
+
+    tree = jax.tree.map(
+        lambda x: _globalize_blocks(x, pc) if isinstance(x, MiniBatch) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, MiniBatch),
+    )
+    return jax.tree.map(put, tree)
+
+
+def replicate_global(mesh: Mesh, tree):
+    """Replicate (identical-on-every-host) values across the global mesh —
+    params/optimizer state in pure data parallelism."""
+    rep = NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(rep, np.asarray(x)),
+        tree,
+    )
