@@ -236,6 +236,10 @@ enum Op : int {
   kOpGetDense,
   kOpRandomWalk,
   kOpSampleFanout,
+  kOpFullNeighbor,
+  kOpDegreeSum,
+  kOpVarlen,
+  kOpLayerwise,
   kNumOps,
 };
 
@@ -270,6 +274,7 @@ struct Store {
   i64 num_edge_types = 0;
   i64 num_node_types = 0;
   std::vector<Csr> adj;
+  std::vector<Csr> inadj;  // in-edge CSRs (empty when shard lacks them)
   std::vector<AliasTable> node_samplers;  // per type + [last] all
   const u64* edge_src = nullptr;
   const u64* edge_dst = nullptr;
@@ -314,6 +319,24 @@ struct Store {
       c.n_rows = num_nodes;
       if (!c.indptr || (nnz && (!c.dst || !c.w))) return false;
       c.BuildCum(nnz);
+    }
+    if (dir.Get<i64>("inadj_0_indptr")) {
+      inadj.resize(num_edge_types);
+      for (i64 t = 0; t < num_edge_types; ++t) {
+        std::string tag = "inadj_" + std::to_string(t);
+        Csr& c = inadj[t];
+        c.indptr = dir.Get<i64>(tag + "_indptr");
+        i64 nnz = 0;
+        c.dst = dir.Get<u64>(tag + "_dst", &nnz);
+        c.w = dir.Get<f32>(tag + "_w");
+        c.eidx = dir.Get<i64>(tag + "_eidx");
+        c.n_rows = num_nodes;
+        if (!c.indptr || (nnz && (!c.dst || !c.w))) {
+          inadj.clear();
+          break;
+        }
+        c.BuildCum(nnz);
+      }
     }
     node_samplers.resize(num_node_types + 1);
     for (i64 t = 0; t < num_node_types; ++t)
@@ -638,6 +661,329 @@ void etpu_random_walk(void* h, const u64* ids, i64 n, const i32* types,
         }
         out[i * (walk_len + 1) + step] = nxt;
         cur = nxt;
+      }
+    }
+  });
+}
+
+// -------- extended query families (node.h:82-145 parity: full/top-k
+// neighbors, degrees, in-edges, varlen features, layerwise sampling) -----
+
+// CSR set for a direction; nullptr when the shard has no in-edge CSRs.
+static const std::vector<Csr>* CsrSet(const Store* s, u8 in_edges) {
+  if (!in_edges) return &s->adj;
+  return s->inadj.empty() ? nullptr : &s->inadj;
+}
+
+void etpu_degree_sum(void* h, const u64* ids, i64 n, const i32* types,
+                     i64 ntypes, u8 in_edges, i64* out) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpDegreeSum);
+  const std::vector<Csr>* set = CsrSet(s, in_edges);
+  std::vector<i32> all_types;
+  if (ntypes == 0) {
+    for (i64 t = 0; t < s->num_edge_types; ++t) all_types.push_back((i32)t);
+    types = all_types.data();
+    ntypes = all_types.size();
+  }
+  ParallelFor(n, 2048, [&](i64 lo, i64 hi) {
+    for (i64 i = lo; i < hi; ++i) {
+      i64 row = s->Lookup(ids[i]);
+      i64 d = 0;
+      if (row >= 0 && set)
+        for (i64 k = 0; k < ntypes; ++k) d += (*set)[types[k]].Degree(row);
+      out[i] = d;
+    }
+  });
+}
+
+// Padded full adjacency [n, cap]; sort_mode: 0 storage order, 1 by id asc,
+// 2 by weight desc (both stable, invalid slots last).
+void etpu_full_neighbor(void* h, const u64* ids, i64 n, const i32* types,
+                        i64 ntypes, i64 cap, u8 in_edges, i32 sort_mode,
+                        u64* nbr, f32* w, i32* tt, u8* mask, i64* eidx) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpFullNeighbor);
+  const std::vector<Csr>* set = CsrSet(s, in_edges);
+  std::vector<i32> all_types;
+  if (ntypes == 0) {
+    for (i64 t = 0; t < s->num_edge_types; ++t) all_types.push_back((i32)t);
+    types = all_types.data();
+    ntypes = all_types.size();
+  }
+  ParallelFor(n, 64, [&](i64 lo, i64 hi) {
+    std::vector<i64> order;
+    for (i64 i = lo; i < hi; ++i) {
+      u64* rn = nbr + i * cap;
+      f32* rw = w + i * cap;
+      i32* rt = tt + i * cap;
+      u8* rm = mask + i * cap;
+      i64* re = eidx + i * cap;
+      for (i64 c = 0; c < cap; ++c) {
+        rn[c] = kDefaultId;
+        rw[c] = 0.f;
+        rt[c] = -1;
+        rm[c] = 0;
+        re[c] = -1;
+      }
+      i64 row = s->Lookup(ids[i]);
+      if (row < 0 || !set) continue;
+      i64 col = 0;
+      for (i64 k = 0; k < ntypes && col < cap; ++k) {
+        const Csr& c = (*set)[types[k]];
+        for (i64 el = c.indptr[row]; el < c.indptr[row + 1] && col < cap;
+             ++el, ++col) {
+          rn[col] = c.dst[el];
+          rw[col] = c.w[el];
+          rt[col] = types[k];
+          rm[col] = 1;
+          re[col] = c.eidx ? c.eidx[el] : -1;
+        }
+      }
+      if (sort_mode && col > 1) {
+        order.resize(col);
+        for (i64 j = 0; j < col; ++j) order[j] = j;
+        if (sort_mode == 1)
+          std::stable_sort(order.begin(), order.end(),
+                           [&](i64 a, i64 b) { return rn[a] < rn[b]; });
+        else
+          std::stable_sort(order.begin(), order.end(),
+                           [&](i64 a, i64 b) { return rw[a] > rw[b]; });
+        std::vector<u64> tn(col);
+        std::vector<f32> tw(col);
+        std::vector<i32> ttv(col);
+        std::vector<i64> te(col);
+        for (i64 j = 0; j < col; ++j) {
+          tn[j] = rn[order[j]];
+          tw[j] = rw[order[j]];
+          ttv[j] = rt[order[j]];
+          te[j] = re[order[j]];
+        }
+        memcpy(rn, tn.data(), sizeof(u64) * col);
+        memcpy(rw, tw.data(), sizeof(f32) * col);
+        memcpy(rt, ttv.data(), sizeof(i32) * col);
+        memcpy(re, te.data(), sizeof(i64) * col);
+      }
+    }
+  });
+}
+
+// Variable-length (sparse u64 / binary u8) feature plumbing. Rows are
+// pre-resolved store rows (node or edge space); kind 0 = sparse, 1 = binary.
+static bool VarlenArrays(Store* s, u8 node, i32 kind, i64 fid,
+                         const i64** indptr, const u8** values_u8,
+                         const u64** values_u64, i64* nrows) {
+  std::string base = std::string(node ? "nf_" : "ef_") +
+                     (kind == 0 ? "sparse_" : "bin_") + std::to_string(fid);
+  const i64* ip = s->dir.Get<i64>(base + "_indptr", nrows);
+  if (!ip) return false;
+  *indptr = ip;
+  if (kind == 0)
+    *values_u64 = s->dir.Get<u64>(base + "_values");
+  else
+    *values_u8 = s->dir.Get<u8>(base + "_values");
+  *nrows -= 1;  // indptr has nrows+1 entries
+  return true;
+}
+
+void etpu_varlen_lens(void* h, const i64* rows, i64 n, u8 node, i32 kind,
+                      i64 fid, i64* lens) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpVarlen);
+  const i64* indptr = nullptr;
+  const u8* vu8 = nullptr;
+  const u64* vu64 = nullptr;
+  i64 nrows = 0;
+  if (!VarlenArrays(s, node, kind, fid, &indptr, &vu8, &vu64, &nrows)) {
+    memset(lens, 0, sizeof(i64) * n);
+    return;
+  }
+  ParallelFor(n, 4096, [&](i64 lo, i64 hi) {
+    for (i64 i = lo; i < hi; ++i)
+      lens[i] = (rows[i] < 0 || rows[i] >= nrows)
+                    ? 0
+                    : indptr[rows[i] + 1] - indptr[rows[i]];
+  });
+}
+
+void etpu_varlen_gather_u64(void* h, const i64* rows, i64 n, u8 node,
+                            i32 kind, i64 fid, i64 cap, u64* vals, u8* mask) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpVarlen);
+  memset(vals, 0, sizeof(u64) * n * cap);
+  memset(mask, 0, sizeof(u8) * n * cap);
+  const i64* indptr = nullptr;
+  const u8* vu8 = nullptr;
+  const u64* vu64 = nullptr;
+  i64 nrows = 0;
+  if (!VarlenArrays(s, node, kind, fid, &indptr, &vu8, &vu64, &nrows) ||
+      !vu64)
+    return;
+  ParallelFor(n, 1024, [&](i64 lo, i64 hi) {
+    for (i64 i = lo; i < hi; ++i) {
+      if (rows[i] < 0 || rows[i] >= nrows) continue;
+      i64 s0 = indptr[rows[i]];
+      i64 len = std::min(indptr[rows[i] + 1] - s0, cap);
+      for (i64 j = 0; j < len; ++j) {
+        vals[i * cap + j] = vu64[s0 + j];
+        mask[i * cap + j] = 1;
+      }
+    }
+  });
+}
+
+void etpu_varlen_gather_u8(void* h, const i64* rows, i64 n, u8 node, i32 kind,
+                           i64 fid, i64 cap, u8* vals) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpVarlen);
+  memset(vals, 0, sizeof(u8) * n * cap);
+  const i64* indptr = nullptr;
+  const u8* vu8 = nullptr;
+  const u64* vu64 = nullptr;
+  i64 nrows = 0;
+  if (!VarlenArrays(s, node, kind, fid, &indptr, &vu8, &vu64, &nrows) || !vu8)
+    return;
+  ParallelFor(n, 1024, [&](i64 lo, i64 hi) {
+    for (i64 i = lo; i < hi; ++i) {
+      if (rows[i] < 0 || rows[i] >= nrows) continue;
+      i64 s0 = indptr[rows[i]];
+      i64 len = std::min(indptr[rows[i] + 1] - s0, cap);
+      memcpy(vals + i * cap, vu8 + s0, len);
+    }
+  });
+}
+
+// LADIES-style layerwise sampling (sample_layer_op.cc:83 parity): one
+// shared candidate set per batch, sampled ∝ total incident weight, plus the
+// batch→layer adjacency restricted to the sampled candidates.
+void etpu_layerwise(void* h, const u64* ids, i64 n, const i32* types,
+                    i64 ntypes, i64 count, u64 seed, u64* layer, f32* adj,
+                    u8* lmask) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpLayerwise);
+  std::vector<i32> all_types;
+  if (ntypes == 0) {
+    for (i64 t = 0; t < s->num_edge_types; ++t) all_types.push_back((i32)t);
+    types = all_types.data();
+    ntypes = all_types.size();
+  }
+  for (i64 j = 0; j < count; ++j) {
+    layer[j] = kDefaultId;
+    lmask[j] = 0;
+  }
+  memset(adj, 0, sizeof(f32) * n * count);
+  // candidate weights: sum of incident edge weight from the whole batch
+  std::unordered_map<u64, double> cand;
+  std::vector<i64> rowv(n);
+  for (i64 i = 0; i < n; ++i) {
+    rowv[i] = s->Lookup(ids[i]);
+    if (rowv[i] < 0) continue;
+    for (i64 k = 0; k < ntypes; ++k) {
+      const Csr& c = s->adj[types[k]];
+      for (i64 el = c.indptr[rowv[i]]; el < c.indptr[rowv[i] + 1]; ++el)
+        cand[c.dst[el]] += c.w[el];
+    }
+  }
+  if (cand.empty()) return;
+  std::vector<u64> uniq;
+  uniq.reserve(cand.size());
+  for (auto& kv : cand) uniq.push_back(kv.first);
+  std::sort(uniq.begin(), uniq.end());
+  std::vector<double> cum(uniq.size() + 1, 0.0);
+  for (size_t j = 0; j < uniq.size(); ++j)
+    cum[j + 1] = cum[j] + cand[uniq[j]];
+  // `count` weighted draws with replacement, then dedupe (ascending)
+  SplitMix64 rng(seed ^ 0xa0761d6478bd642full);
+  std::vector<u64> drawn;
+  drawn.reserve(count);
+  for (i64 d = 0; d < count; ++d) {
+    double target = rng.uniform() * cum.back();
+    size_t a = 0, b = uniq.size();
+    while (a < b) {
+      size_t m = (a + b) / 2;
+      if (cum[m + 1] <= target)
+        a = m + 1;
+      else
+        b = m;
+    }
+    drawn.push_back(uniq[std::min(a, uniq.size() - 1)]);
+  }
+  std::sort(drawn.begin(), drawn.end());
+  drawn.erase(std::unique(drawn.begin(), drawn.end()), drawn.end());
+  i64 klen = (i64)drawn.size();
+  for (i64 j = 0; j < klen; ++j) {
+    layer[j] = drawn[j];
+    lmask[j] = 1;
+  }
+  // batch → layer adjacency over the sampled (sorted) candidate set
+  ParallelFor(n, 128, [&](i64 lo, i64 hi) {
+    for (i64 i = lo; i < hi; ++i) {
+      if (rowv[i] < 0) continue;
+      for (i64 k = 0; k < ntypes; ++k) {
+        const Csr& c = s->adj[types[k]];
+        for (i64 el = c.indptr[rowv[i]]; el < c.indptr[rowv[i] + 1]; ++el) {
+          u64 d = c.dst[el];
+          auto it = std::lower_bound(drawn.begin(), drawn.end(), d);
+          if (it != drawn.end() && *it == d)
+            adj[i * count + (it - drawn.begin())] += c.w[el];
+        }
+      }
+    }
+  });
+}
+
+// Directional weighted neighbor sampling (in_edges=1 draws from in-CSRs).
+void etpu_sample_neighbor_dir(void* h, const u64* ids, i64 n,
+                              const i32* types, i64 ntypes, i64 count,
+                              u8 in_edges, u64 seed, u64* nbr, f32* w,
+                              i32* tt, u8* mask, i64* eidx) {
+  auto* s = (Store*)h;
+  if (!in_edges) {
+    etpu_sample_neighbor(h, ids, n, types, ntypes, count, seed, nbr, w, tt,
+                         mask, eidx);
+    return;
+  }
+  ScopedTimer timer(s->stats, kOpSampleNeighbor);
+  const std::vector<Csr>* set = CsrSet(s, 1);
+  std::vector<i32> all_types;
+  if (ntypes == 0) {
+    for (i64 t = 0; t < s->num_edge_types; ++t) all_types.push_back((i32)t);
+    types = all_types.data();
+    ntypes = all_types.size();
+  }
+  ParallelFor(n, 256, [&](i64 lo, i64 hi) {
+    SplitMix64 rng(seed ^ (0x8bb84b93962eacc9ull * (u64)(lo + 1)));
+    std::vector<double> tot(ntypes);
+    for (i64 i = lo; i < hi; ++i) {
+      i64 row = s->Lookup(ids[i]);
+      double total = 0.0;
+      for (i64 k = 0; k < ntypes; ++k) {
+        tot[k] = (row < 0 || !set) ? 0.0 : (*set)[types[k]].RowWeight(row);
+        total += tot[k];
+      }
+      for (i64 c = 0; c < count; ++c) {
+        i64 o = i * count + c;
+        nbr[o] = kDefaultId;
+        w[o] = 0.f;
+        tt[o] = -1;
+        mask[o] = 0;
+        eidx[o] = -1;
+        if (row < 0 || !set || total <= 0) continue;
+        double u = rng.uniform() * total;
+        i64 pick = 0;
+        double acc = 0.0;
+        for (; pick < ntypes - 1; ++pick) {
+          acc += tot[pick];
+          if (u < acc) break;
+        }
+        const Csr& cs = (*set)[types[pick]];
+        i64 el = cs.SampleInRow(row, rng);
+        if (el < 0) continue;
+        nbr[o] = cs.dst[el];
+        w[o] = cs.w[el];
+        tt[o] = types[pick];
+        mask[o] = 1;
+        eidx[o] = cs.eidx ? cs.eidx[el] : -1;
       }
     }
   });

@@ -97,6 +97,32 @@ def _load_lib():
     ]
     lib.etpu_stats.argtypes = [c.c_void_p, u64p]
     lib.etpu_reset_stats.argtypes = [c.c_void_p]
+    lib.etpu_degree_sum.argtypes = [
+        c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_uint8, i64p,
+    ]
+    lib.etpu_full_neighbor.argtypes = [
+        c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_int64,
+        c.c_uint8, c.c_int32, u64p, f32p, i32p, u8p, i64p,
+    ]
+    lib.etpu_varlen_lens.argtypes = [
+        c.c_void_p, i64p, c.c_int64, c.c_uint8, c.c_int32, c.c_int64, i64p,
+    ]
+    lib.etpu_varlen_gather_u64.argtypes = [
+        c.c_void_p, i64p, c.c_int64, c.c_uint8, c.c_int32, c.c_int64,
+        c.c_int64, u64p, u8p,
+    ]
+    lib.etpu_varlen_gather_u8.argtypes = [
+        c.c_void_p, i64p, c.c_int64, c.c_uint8, c.c_int32, c.c_int64,
+        c.c_int64, u8p,
+    ]
+    lib.etpu_layerwise.argtypes = [
+        c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_int64,
+        c.c_uint64, u64p, f32p, u8p,
+    ]
+    lib.etpu_sample_neighbor_dir.argtypes = [
+        c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_int64,
+        c.c_uint8, c.c_uint64, u64p, f32p, i32p, u8p, i64p,
+    ]
     _lib = lib
     return lib
 
@@ -110,6 +136,10 @@ STAT_OPS = (
     "get_dense",
     "random_walk",
     "sample_fanout",
+    "full_neighbor",
+    "degree_sum",
+    "varlen_feature",
+    "layerwise",
 )
 
 
