@@ -196,11 +196,18 @@ struct Csr {
   const i64* eidx = nullptr;
   i64 n_rows = 0;
   std::vector<double> cum;  // [nnz+1] cumulative weights
+  std::vector<i64> dst_row;  // [nnz] local row of each dst (-1 off-shard);
+                             // kills the per-sample id binary search
+  bool uniform = false;  // all weights equal → O(1) in-row sampling
 
   void BuildCum(i64 nnz) {
     cum.resize(nnz + 1);
     cum[0] = 0.0;
-    for (i64 i = 0; i < nnz; ++i) cum[i + 1] = cum[i] + w[i];
+    uniform = true;
+    for (i64 i = 0; i < nnz; ++i) {
+      cum[i + 1] = cum[i] + w[i];
+      uniform &= w[i] == w[0];
+    }
   }
 
   i64 Degree(i64 row) const { return indptr[row + 1] - indptr[row]; }
@@ -211,6 +218,10 @@ struct Csr {
   i64 SampleInRow(i64 row, SplitMix64& rng) const {
     i64 s = indptr[row], e = indptr[row + 1];
     if (s >= e) return -1;
+    if (uniform) {
+      i64 i = s + (i64)(rng.uniform() * (e - s));
+      return i < e ? i : e - 1;
+    }
     double lo = cum[s], hi = cum[e];
     double target = lo + rng.uniform() * (hi - lo);
     // binary search in cum[s..e]
@@ -336,6 +347,18 @@ struct Store {
           break;
         }
         c.BuildCum(nnz);
+      }
+    }
+    // pre-resolve each adjacency dst to its local row once, so sampling
+    // paths never pay the per-sample id binary search
+    for (auto* set : {&adj, &inadj}) {
+      for (Csr& c : *set) {
+        if (!c.indptr) continue;
+        i64 nnz = c.indptr[num_nodes];
+        c.dst_row.resize(nnz);
+        ParallelFor(nnz, 65536, [&](i64 lo, i64 hi) {
+          for (i64 i = lo; i < hi; ++i) c.dst_row[i] = Lookup(c.dst[i]);
+        });
       }
     }
     node_samplers.resize(num_node_types + 1);
@@ -566,7 +589,7 @@ void etpu_sample_fanout(void* h, const u64* roots, i64 n, const i32* types,
               PickNeighbor(s, row, types, ntypes, tot.data(), total, rng);
           if (p.el < 0) continue;
           nbr[o] = p.csr->dst[p.el];
-          nrow[o] = s->Lookup(p.csr->dst[p.el]);
+          nrow[o] = p.csr->dst_row[p.el];
           nw[o] = p.csr->w[p.el];
           ntt[o] = p.type;
           nm[o] = 1;

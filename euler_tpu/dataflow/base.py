@@ -101,13 +101,25 @@ class DataFlow:
 
 
 def fanout_block(
-    batch: int, fanout: int, w: np.ndarray, mask: np.ndarray, lazy: bool = False
+    batch: int,
+    fanout: int,
+    w: np.ndarray,
+    mask: np.ndarray,
+    lazy: bool = False,
+    ship_w: bool = True,
+    ship_mask: bool = True,
 ) -> Block:
     """Block for sampled fanout: src j feeds dst j // fanout.
 
     lazy=True skips materializing edge_src/edge_dst — they are a pure
     function of (batch, fanout), so shipping them to the device every step
     wastes host→device bandwidth; `hydrate_blocks` rebuilds them on device.
+    ship_mask=False / ship_w=False likewise omit the edge mask / weights
+    from the wire: hydrate_blocks rederives the mask from the rows-mode
+    validity of the src hop and sets edge_w to exactly 1.0 where valid.
+    Only valid for rows-mode batches whose consumer is weight-agnostic
+    (mask-normalized mean/attention aggregators) or whose graph weights
+    are all 1.0 — a uniform weight c != 1 would be rebuilt as 1.
     """
     e = batch * fanout
     return Block(
@@ -115,8 +127,8 @@ def fanout_block(
         edge_dst=None if lazy else np.repeat(
             np.arange(batch, dtype=np.int32), fanout
         ),
-        edge_w=w.reshape(-1).astype(np.float32),
-        mask=mask.reshape(-1),
+        edge_w=w.reshape(-1).astype(np.float32) if ship_w else None,
+        mask=mask.reshape(-1) if ship_mask else None,
         n_src=e,
         n_dst=batch,
         grid=fanout,
@@ -124,24 +136,45 @@ def fanout_block(
 
 
 def hydrate_blocks(batch: MiniBatch) -> MiniBatch:
-    """Rebuild lazy grid blocks' edge ids with on-device iota (jit-safe)."""
+    """Rebuild wire-omitted batch pieces on device (jit-safe).
+
+    - lazy grid blocks' edge ids: on-device iota
+    - batch.masks is None (lean wire): node validity = rows-mode feat > 0
+    - block.mask is None: the src hop's node mask (grid layout aligns them)
+    - block.edge_w is None: uniform weights (mask as f32)
+    """
     import jax.numpy as jnp
 
-    if not isinstance(batch, MiniBatch) or all(
-        b.edge_src is not None for b in batch.blocks
+    if not isinstance(batch, MiniBatch):
+        return batch
+    masks = batch.masks
+    if masks is None:  # lean wire: validity rides the int32 rows (0 = pad)
+        masks = tuple(
+            (f > 0)
+            if jnp.issubdtype(jnp.asarray(f).dtype, jnp.integer)
+            else jnp.ones(f.shape[0], bool)
+            for f in batch.feats
+        )
+        # hop 0 keeps the non-lean invariant: any non-DEFAULT_ID root is
+        # valid even when absent from the feature store (its features are
+        # the zero row). root_idx truncates DEFAULT_ID to int32 -1.
+        masks = (batch.root_idx != -1,) + masks[1:]
+    blocks = []
+    for h, b in enumerate(batch.blocks):
+        if b.mask is None:
+            b = b.replace(mask=masks[h + 1].reshape(-1))
+        if b.edge_w is None:
+            b = b.replace(edge_w=b.mask.astype(jnp.float32))
+        if b.edge_src is None:
+            b = b.replace(
+                edge_src=jnp.arange(b.n_src, dtype=jnp.int32),
+                edge_dst=jnp.repeat(
+                    jnp.arange(b.n_dst, dtype=jnp.int32), b.grid
+                ),
+            )
+        blocks.append(b)
+    if masks is batch.masks and all(
+        a is b for a, b in zip(blocks, batch.blocks)
     ):
         return batch
-    blocks = []
-    for b in batch.blocks:
-        if b.edge_src is None:
-            blocks.append(
-                b.replace(
-                    edge_src=jnp.arange(b.n_src, dtype=jnp.int32),
-                    edge_dst=jnp.repeat(
-                        jnp.arange(b.n_dst, dtype=jnp.int32), b.grid
-                    ),
-                )
-            )
-        else:
-            blocks.append(b)
-    return batch.replace(blocks=tuple(blocks))
+    return batch.replace(masks=masks, blocks=tuple(blocks))

@@ -22,13 +22,21 @@ class SageDataFlow(DataFlow):
         rng=None,
         feature_mode="dense",
         lazy_blocks: bool = False,
+        lean: bool = False,
     ):
+        """lean=True minimizes wire bytes on the fused rows path: ships only
+        int32 feature rows + labels, with edge ids, masks, and (uniform)
+        weights rebuilt on device by hydrate_blocks. Requires
+        feature_mode="rows"; hop_ids are omitted (no id-embedding models)."""
+        if lean and feature_mode != "rows":
+            raise ValueError("lean=True requires feature_mode='rows'")
         super().__init__(
             graph, feature_names, label_feature, label_dim, rng, feature_mode
         )
         self.edge_types = edge_types
         self.fanouts = list(fanouts)
-        self.lazy_blocks = lazy_blocks
+        self.lazy_blocks = lazy_blocks or lean
+        self.lean = lean
 
     @property
     def num_hops(self) -> int:
@@ -49,11 +57,30 @@ class SageDataFlow(DataFlow):
             # hop-0 validity matches the fallback path (any non-default id
             # counts, even if absent from the store — its features are zero)
             hop_masks = [roots != DEFAULT_ID] + list(hop_masks[1:])
+            lean = self.lean
+            if lean:
+                # lean hydration rebuilds edge_w as 1.0 and derives hop-0
+                # validity from int32 root_idx; when a batch violates either
+                # assumption (non-unit weights, a valid id truncating to
+                # -1), ship the real arrays for that batch instead of
+                # silently training on wrong values
+                unit_w = all(
+                    np.all(w[m] == 1.0)
+                    for w, m in zip(hop_w[1:], hop_masks[1:])
+                )
+                root32 = roots.astype(np.int64).astype(np.int32)
+                alias = bool(((root32 == -1) & (roots != DEFAULT_ID)).any())
+                lean = unit_w and not alias
             blocks = []
             width = len(roots)
             for k, w, mask in zip(self.fanouts, hop_w[1:], hop_masks[1:]):
                 blocks.append(
-                    fanout_block(width, k, w, mask, lazy=self.lazy_blocks)
+                    fanout_block(
+                        width, k, w, mask,
+                        lazy=self.lazy_blocks,
+                        ship_w=not lean,
+                        ship_mask=not lean,
+                    )
                 )
                 width *= k
             if self.feature_mode == "rows":
@@ -84,6 +111,7 @@ class SageDataFlow(DataFlow):
             else:
                 feats = tuple(self.node_feats(ids) for ids in hop_ids)
         else:
+            lean = False  # no fused rows → nothing to derive masks from
             hop_ids = [roots]
             hop_masks = [roots != DEFAULT_ID]
             blocks = []
@@ -102,11 +130,13 @@ class SageDataFlow(DataFlow):
             feats = tuple(self.node_feats(ids) for ids in hop_ids)
         return MiniBatch(
             feats=feats,
-            masks=tuple(hop_masks),
+            masks=None if lean else tuple(hop_masks),
             blocks=tuple(blocks),
             root_idx=roots.astype(np.int64).astype(np.int32),
             labels=self.labels_of(roots),
-            hop_ids=tuple(
+            hop_ids=None
+            if lean
+            else tuple(
                 ids.astype(np.int64).astype(np.int32) for ids in hop_ids
             ),
         )

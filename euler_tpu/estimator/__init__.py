@@ -7,6 +7,7 @@ from euler_tpu.estimator.estimator import (  # noqa: F401
     node_batches,
     read_sample_ids,
     sample_file_batches,
+    stack_batches,
     unsupervised_batches,
 )
 from euler_tpu.estimator.feature_cache import DeviceFeatureCache  # noqa: F401

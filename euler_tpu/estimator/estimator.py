@@ -39,6 +39,12 @@ class EstimatorConfig:
     profile_dir: str = ""
     profile_start_step: int = 10
     profile_steps: int = 5
+    # steps per XLA dispatch: >1 runs a lax.scan of K optimizer steps over
+    # batches stacked on a leading K axis (batch_fn must return them that
+    # way, e.g. via `stack_batches`). Amortizes host→device dispatch latency
+    # — the TPU analog of the reference keeping its query pipeline async
+    # (query_proxy.cc:205-256) so the trainer never stalls per step.
+    steps_per_call: int = 1
 
 
 def make_optimizer(cfg: EstimatorConfig) -> optax.GradientTransformation:
@@ -84,17 +90,20 @@ class Estimator:
         self._rng_names = tuple(getattr(model, "rng_collections", ()))
         self._base_key = jax.random.PRNGKey((cfg or EstimatorConfig()).seed + 1)
         self._jit_train = None
+        self._jit_train_scan = None
         self._jit_eval = None
         self._jit_embed = None
 
     # -- state -----------------------------------------------------------
 
-    def _put(self, batch):
+    def _put(self, batch, stacked: bool = False):
         if self.mesh is None:
             return batch
         from euler_tpu.parallel import shard_batch
 
-        return shard_batch(batch, self.mesh)
+        # stacked [K_steps, batch, ...] items shard axis 1 (the real batch
+        # axis); the scan axis stays unsharded
+        return shard_batch(batch, self.mesh, batch_axis=1 if stacked else 0)
 
     def _hydrate(self, batch: tuple) -> tuple:
         from euler_tpu.dataflow.base import MiniBatch, hydrate_blocks
@@ -114,7 +123,12 @@ class Estimator:
             return
         import flax.linen as nn
 
-        batch = self._hydrate(self._put(self.batch_fn()))
+        batch = self._put(
+            self.batch_fn(), stacked=self.cfg.steps_per_call > 1
+        )
+        if self.cfg.steps_per_call > 1:  # stacked [K, ...] → init on slice 0
+            batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        batch = self._hydrate(batch)
         key = jax.random.PRNGKey(self.cfg.seed)
         keys = jax.random.split(key, 1 + len(self._rng_names))
         rngs = {"params": keys[0]}
@@ -158,6 +172,45 @@ class Estimator:
             self._jit_train = train_step
         return self._jit_train
 
+    def _train_step_scan(self):
+        """K optimizer steps per dispatch via lax.scan over stacked batches."""
+        if self._jit_train_scan is None:
+
+            @jax.jit
+            def multi_step(params, opt_state, rngs, *stacked_batch):
+                def body(carry, xs):
+                    params, opt_state = carry
+                    step_rngs, batch = xs
+                    batch = self._hydrate(batch)
+
+                    def loss_fn(p):
+                        _, loss, _, metric = self.model.apply(
+                            p, *batch, rngs=step_rngs
+                        )
+                        return loss, metric
+
+                    (loss, metric), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params)
+                    updates, opt_state = self.tx.update(
+                        grads, opt_state, params
+                    )
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), (loss, metric)
+
+                (params, opt_state), (losses, metrics) = jax.lax.scan(
+                    body, (params, opt_state), (rngs, stacked_batch)
+                )
+                return params, opt_state, losses, metrics[-1]
+
+            self._jit_train_scan = multi_step
+        return self._jit_train_scan
+
+    def _rngs_stacked(self, step: int, k: int):
+        if not self._rng_names:
+            return None
+        return jax.vmap(lambda s: self._rngs(s))(jnp.arange(step, step + k))
+
     # -- drivers (train/evaluate/infer/train_and_evaluate) ---------------
 
     def train(
@@ -165,6 +218,9 @@ class Estimator:
     ):
         self._ensure_init()
         steps = total_steps if total_steps is not None else self.cfg.total_steps
+        k = max(int(self.cfg.steps_per_call), 1)
+        if k > 1:
+            return self._train_scan(steps, k, log=log, save=save)
         step_fn = self._train_step()
         t0 = time.time()
         history = []  # on-device losses not yet drained to the host
@@ -219,6 +275,74 @@ class Estimator:
         if history:
             fetched.extend(np.asarray(jnp.stack(history)).tolist())
         return fetched
+
+    def _train_scan(self, steps: int, k: int, log: bool, save: bool):
+        """Driver for steps_per_call>1: each batch_fn() item is a K-stacked
+        batch; one jitted dispatch advances K optimizer steps. A non-multiple
+        remainder (steps % k) runs through the single-step path on slices of
+        one final stacked item, so exactly `steps` updates are applied."""
+        step_fn = self._train_step_scan()
+        t0 = time.time()
+        history = []
+        fetched: list[float] = []
+        drain_every = max(4096 // k, 1)
+        calls, remainder = divmod(steps, k)
+        profiling = False
+        for _ in range(calls):
+            if (
+                self.cfg.profile_dir
+                and not getattr(self, "_profiled", False)
+                and self.step >= self.cfg.profile_start_step
+            ):
+                jax.profiler.start_trace(self.cfg.profile_dir)
+                profiling = True
+                profile_stop = self.step + max(self.cfg.profile_steps, k)
+                self._profiled = True
+            batch = self._put(self.batch_fn(), stacked=True)
+            rngs = self._rngs_stacked(self.step, k)
+            self.params, self.opt_state, losses, metric = step_fn(
+                self.params, self.opt_state, rngs, *batch
+            )
+            self.step += k
+            if profiling and self.step >= profile_stop:
+                jax.block_until_ready(losses)
+                jax.profiler.stop_trace()
+                profiling = False
+            if log and self.step % max(self.cfg.log_steps, 1) < k:
+                dt = time.time() - t0
+                print(
+                    f"step {self.step}: loss={float(losses[-1]):.4f} "
+                    f"metric={float(metric):.4f} ({self.step / dt:.1f} it/s)"
+                )
+            history.append(losses)
+            if len(history) >= drain_every:
+                fetched.extend(
+                    np.asarray(jnp.concatenate(history)).tolist()
+                )
+                history = []
+            if (
+                self.cfg.checkpoint_steps
+                and self.step % self.cfg.checkpoint_steps < k
+            ):
+                self.save()
+        if profiling:
+            jax.block_until_ready(self.params)
+            jax.profiler.stop_trace()
+        if remainder:
+            single = self._train_step()
+            item = self._put(self.batch_fn(), stacked=True)
+            for i in range(remainder):
+                batch = jax.tree_util.tree_map(lambda x: x[i], item)
+                self.params, self.opt_state, loss, _ = single(
+                    self.params, self.opt_state, self._rngs(self.step), *batch
+                )
+                self.step += 1
+                history.append(loss[None])
+        if save:
+            self.save()
+        if history:
+            fetched.extend(np.asarray(jnp.concatenate(history)).tolist())
+        return fetched[:steps]
 
     def evaluate(self, batches: Iterable[tuple]) -> dict:
         self._ensure_init()
@@ -326,6 +450,17 @@ class Estimator:
         self.params = restored["params"]
         self.step = int(restored["step"])
         return True
+
+
+def stack_batches(batch_fn: Callable[[], tuple], k: int) -> Callable[[], tuple]:
+    """Wrap a batch source to return K batches stacked on a leading axis,
+    for `EstimatorConfig.steps_per_call=K` scan training."""
+
+    def fn():
+        batches = [batch_fn() for _ in range(k)]
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+
+    return fn
 
 
 # ---- batch sources (Node/Edge estimator input_fn parity) ----------------

@@ -51,6 +51,28 @@ def _u64p(a):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
 
 
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u8p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _types_arr(edge_types):
+    return np.ascontiguousarray(
+        [] if edge_types is None else list(edge_types), dtype=np.int32
+    )
+
+
 def _load_lib():
     global _lib
     if _lib is not None:
@@ -214,33 +236,189 @@ class NativeGraphStore(GraphStore):
         return out
 
     def sample_neighbor(self, ids, edge_types=None, count=10, rng=None, in_edges=False):
-        if in_edges:  # cold path
+        if in_edges and not self.inadj:  # no in-CSRs on this shard
             return super().sample_neighbor(ids, edge_types, count, rng, in_edges)
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
         n = len(ids)
-        types = np.ascontiguousarray(
-            [] if edge_types is None else list(edge_types), dtype=np.int32
-        )
+        types = _types_arr(edge_types)
         nbr = np.empty((n, count), dtype=np.uint64)
         w = np.empty((n, count), dtype=np.float32)
         tt = np.empty((n, count), dtype=np.int32)
         mask = np.empty((n, count), dtype=np.uint8)
         eidx = np.empty((n, count), dtype=np.int64)
-        self._lib.etpu_sample_neighbor(
+        self._lib.etpu_sample_neighbor_dir(
             ctypes.c_void_p(self._h),
             _u64p(ids),
             n,
-            types.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            _i32p(types),
+            len(types),
+            count,
+            ctypes.c_uint8(1 if in_edges else 0),
+            ctypes.c_uint64(self._seed(rng)),
+            _u64p(nbr),
+            _f32p(w),
+            _i32p(tt),
+            _u8p(mask),
+            _i64p(eidx),
+        )
+        return nbr, w, tt, mask.astype(bool), eidx
+
+    def degree_sum(self, ids, edge_types=None, in_edges=False):
+        if in_edges and not self.inadj:
+            return super().degree_sum(ids, edge_types, in_edges)
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        types = _types_arr(edge_types)
+        out = np.empty(len(ids), dtype=np.int64)
+        self._lib.etpu_degree_sum(
+            ctypes.c_void_p(self._h),
+            _u64p(ids),
+            len(ids),
+            _i32p(types),
+            len(types),
+            ctypes.c_uint8(1 if in_edges else 0),
+            _i64p(out),
+        )
+        return out
+
+    def get_full_neighbor(
+        self, ids, edge_types=None, max_degree=None, in_edges=False, sort_by=None
+    ):
+        """Padded full adjacency served from the engine (node.h:82-112).
+
+        sort_by: None (storage order) | 'id' | 'weight' (desc); sorting
+        happens per row inside the C++ kernel.
+        """
+        if in_edges and not self.inadj:
+            return super().get_full_neighbor(
+                ids, edge_types, max_degree, in_edges, sort_by
+            )
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        n = len(ids)
+        if max_degree is None:
+            degs = self.degree_sum(ids, edge_types, in_edges)
+            cap = int(degs.max(initial=0))
+        else:
+            cap = int(max_degree)
+        cap = max(cap, 1)
+        types = _types_arr(edge_types)
+        sort_mode = {None: 0, "id": 1, "weight": 2}[sort_by]
+        nbr = np.empty((n, cap), dtype=np.uint64)
+        w = np.empty((n, cap), dtype=np.float32)
+        tt = np.empty((n, cap), dtype=np.int32)
+        mask = np.empty((n, cap), dtype=np.uint8)
+        eidx = np.empty((n, cap), dtype=np.int64)
+        self._lib.etpu_full_neighbor(
+            ctypes.c_void_p(self._h),
+            _u64p(ids),
+            n,
+            _i32p(types),
+            len(types),
+            cap,
+            ctypes.c_uint8(1 if in_edges else 0),
+            ctypes.c_int32(sort_mode),
+            _u64p(nbr),
+            _f32p(w),
+            _i32p(tt),
+            _u8p(mask),
+            _i64p(eidx),
+        )
+        return nbr, w, tt, mask.astype(bool), eidx
+
+    def sample_neighbor_layerwise(
+        self, batch_ids, edge_types=None, count=128, rng=None
+    ):
+        """LADIES-style layer sampling in one engine call."""
+        batch_ids = np.ascontiguousarray(batch_ids, dtype=np.uint64)
+        n = len(batch_ids)
+        types = _types_arr(edge_types)
+        layer = np.empty(count, dtype=np.uint64)
+        adj = np.empty((n, count), dtype=np.float32)
+        lmask = np.empty(count, dtype=np.uint8)
+        self._lib.etpu_layerwise(
+            ctypes.c_void_p(self._h),
+            _u64p(batch_ids),
+            n,
+            _i32p(types),
             len(types),
             count,
             ctypes.c_uint64(self._seed(rng)),
-            _u64p(nbr),
-            w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            tt.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-            eidx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _u64p(layer),
+            _f32p(adj),
+            _u8p(lmask),
         )
-        return nbr, w, tt, mask.astype(bool), eidx
+        return layer, adj, lmask.astype(bool)
+
+    # -- variable-length features (sparse u64 / binary bytes) ------------
+
+    def _varlen_lens(self, rows, node: bool, kind: int, fid: int):
+        rows = np.ascontiguousarray(rows, dtype=np.int64)
+        lens = np.empty(len(rows), dtype=np.int64)
+        self._lib.etpu_varlen_lens(
+            ctypes.c_void_p(self._h),
+            _i64p(rows),
+            len(rows),
+            ctypes.c_uint8(1 if node else 0),
+            ctypes.c_int32(kind),
+            fid,
+            _i64p(lens),
+        )
+        return lens
+
+    def _varlen_by_rows(self, rows, names, kind, node: bool, max_len=None):
+        from euler_tpu.graph.store import SPARSE
+
+        if kind != SPARSE:  # binary handled by get_*_binary_feature below
+            return super()._varlen_by_rows(rows, names, kind, node, max_len)
+        rows = np.ascontiguousarray(rows, dtype=np.int64)
+        out = []
+        for nm in names:
+            spec = self.meta.feature_spec(nm, node=node)
+            lens = self._varlen_lens(rows, node, 0, spec.fid)
+            cap = int(max_len) if max_len else max(int(lens.max(initial=0)), 1)
+            vals = np.empty((len(rows), cap), dtype=np.uint64)
+            mask = np.empty((len(rows), cap), dtype=np.uint8)
+            self._lib.etpu_varlen_gather_u64(
+                ctypes.c_void_p(self._h),
+                _i64p(rows),
+                len(rows),
+                ctypes.c_uint8(1 if node else 0),
+                ctypes.c_int32(0),
+                spec.fid,
+                cap,
+                _u64p(vals),
+                _u8p(mask),
+            )
+            out.append((vals, mask.astype(bool)))
+        return out
+
+    def _binary_by_rows(self, rows, names, node: bool):
+        rows = np.ascontiguousarray(rows, dtype=np.int64)
+        out = []
+        for nm in names:
+            spec = self.meta.feature_spec(nm, node=node)
+            lens = self._varlen_lens(rows, node, 1, spec.fid)
+            cap = max(int(lens.max(initial=0)), 1)
+            vals = np.empty((len(rows), cap), dtype=np.uint8)
+            self._lib.etpu_varlen_gather_u8(
+                ctypes.c_void_p(self._h),
+                _i64p(rows),
+                len(rows),
+                ctypes.c_uint8(1 if node else 0),
+                ctypes.c_int32(1),
+                spec.fid,
+                cap,
+                _u8p(vals),
+            )
+            out.append(
+                [bytes(vals[i, : lens[i]]) for i in range(len(rows))]
+            )
+        return out
+
+    def get_binary_feature(self, ids, names):
+        return self._binary_by_rows(self.lookup(ids), names, node=True)
+
+    def get_edge_binary_feature(self, edge_ids, names):
+        return self._binary_by_rows(self._edge_rows(edge_ids), names, node=False)
 
     def get_dense_feature(self, ids, names):
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
@@ -273,9 +451,7 @@ class NativeGraphStore(GraphStore):
         """
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
         n = len(ids)
-        types = np.ascontiguousarray(
-            [] if edge_types is None else list(edge_types), dtype=np.int32
-        )
+        types = _types_arr(edge_types)
         counts_arr = np.ascontiguousarray(counts, dtype=np.int64)
         widths = [n]
         for c in counts:
@@ -357,9 +533,7 @@ class NativeGraphStore(GraphStore):
         if p != 1.0 or q != 1.0:  # node2vec bias → numpy path
             return super().random_walk(ids, edge_types, walk_len, p, q, rng)
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
-        types = np.ascontiguousarray(
-            [] if edge_types is None else list(edge_types), dtype=np.int32
-        )
+        types = _types_arr(edge_types)
         out = np.empty((len(ids), walk_len + 1), dtype=np.uint64)
         self._lib.etpu_random_walk(
             ctypes.c_void_p(self._h),

@@ -42,18 +42,26 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh):
-    """device_put a MiniBatch/pytree: leading-dim sharding where divisible.
+def shard_batch(batch, mesh: Mesh, batch_axis: int = 0):
+    """device_put a MiniBatch/pytree: batch-dim sharding where divisible.
 
-    Arrays whose leading dim divides the data-axis size are split across it;
-    everything else (scalars, ragged leftovers) is replicated.
+    Arrays whose `batch_axis` dim divides the data-axis size are split
+    across it; everything else (scalars, ragged leftovers) is replicated.
+    batch_axis=1 serves steps_per_call>1 training, where arrays are stacked
+    [K_steps, batch, ...] and the scan axis K must stay unsharded.
     """
     ndata = mesh.shape[DATA_AXIS]
-    ds, rep = data_sharding(mesh), replicated(mesh)
+    ds = NamedSharding(
+        mesh, P(*([None] * batch_axis), DATA_AXIS)
+    )
+    rep = replicated(mesh)
 
     def put(x):
         x = np.asarray(x) if not isinstance(x, jax.Array) else x
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % ndata == 0:
+        if (
+            getattr(x, "ndim", 0) >= batch_axis + 1
+            and x.shape[batch_axis] % ndata == 0
+        ):
             return jax.device_put(x, ds)
         return jax.device_put(x, rep)
 

@@ -146,3 +146,102 @@ def test_training_with_cache(graph, tmp_path):
     history = est.train(log=False)
     assert np.isfinite(history).all()
     assert history[-1] < history[0]
+
+
+def test_lean_wire_matches_full(tmp_path):
+    """lean=True ships only rows+labels; hydration must rebuild masks,
+    edge ids, and uniform weights so training sees an identical batch."""
+    import jax
+    import numpy as np
+
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.dataflow.base import hydrate_blocks
+    from euler_tpu.datasets.synthetic import random_graph
+    from euler_tpu.graph import Graph
+    from euler_tpu.graph import format as tformat
+
+    g = random_graph(num_nodes=500, out_degree=5, feat_dim=8, seed=1)
+    d = str(tmp_path / "g")
+    import os
+
+    os.makedirs(d, exist_ok=True)
+    tformat.write_arrays(os.path.join(d, "part_0"), g.shards[0].arrays)
+    g.meta.save(d)
+    g = Graph.load(d, native=True)
+    if g.fanout_with_rows(np.asarray([1], np.uint64), None, [2]) is None:
+        import pytest
+
+        pytest.skip("fused fanout unavailable")
+
+    roots = g.sample_node(16, rng=np.random.default_rng(0))
+    full = SageDataFlow(
+        g, ["feat"], fanouts=[3, 2], label_feature="label",
+        rng=np.random.default_rng(7), feature_mode="rows", lazy_blocks=True,
+    ).query(roots)
+    lean = SageDataFlow(
+        g, ["feat"], fanouts=[3, 2], label_feature="label",
+        rng=np.random.default_rng(7), feature_mode="rows", lean=True,
+    ).query(roots)
+
+    # wire form: lean ships no masks/hop_ids/edge data
+    assert lean.masks is None and lean.hop_ids is None
+    assert all(b.mask is None and b.edge_w is None for b in lean.blocks)
+    nbytes = lambda mb: sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(mb)
+    )
+    assert nbytes(lean) < nbytes(full) * 0.7
+
+    hf, hl = hydrate_blocks(full), hydrate_blocks(lean)
+    for a, b in zip(hf.feats, hl.feats):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(hf.masks, hl.masks):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for ba, bb in zip(hf.blocks, hl.blocks):
+        np.testing.assert_array_equal(
+            np.asarray(ba.edge_src), np.asarray(bb.edge_src)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ba.edge_dst), np.asarray(bb.edge_dst)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ba.mask), np.asarray(bb.mask)
+        )
+        # uniform-weight graph: rebuilt weights equal the shipped ones
+        np.testing.assert_allclose(
+            np.asarray(ba.edge_w), np.asarray(bb.edge_w)
+        )
+    np.testing.assert_array_equal(
+        np.asarray(hf.labels), np.asarray(hl.labels)
+    )
+
+
+def test_lean_downgrades_on_weighted_graph():
+    """lean=True must ship real masks/weights when edge weights aren't 1.0
+    (hydration would otherwise rebuild them as uniform)."""
+    import numpy as np
+
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.graph import Graph
+
+    nodes = [
+        {"id": i, "type": 0, "weight": 1.0,
+         "features": [{"name": "f", "type": "dense", "value": [float(i)]}]}
+        for i in range(1, 5)
+    ]
+    edges = [
+        {"src": s, "dst": s % 4 + 1, "type": 0, "weight": 2.0, "features": []}
+        for s in range(1, 5)
+    ]
+    g = Graph.from_json({"nodes": nodes, "edges": edges})
+    if g.fanout_with_rows(np.asarray([1], np.uint64), None, [2]) is None:
+        import pytest
+
+        pytest.skip("fused fanout unavailable")
+    flow = SageDataFlow(
+        g, ["f"], fanouts=[2], rng=np.random.default_rng(0),
+        feature_mode="rows", lean=True,
+    )
+    mb = flow.query(np.asarray([1, 2], np.uint64))
+    assert mb.masks is not None  # downgraded: real arrays shipped
+    assert mb.blocks[0].edge_w is not None
+    assert np.all(mb.blocks[0].edge_w[mb.blocks[0].mask] == 2.0)

@@ -187,3 +187,129 @@ def test_fused_fanout_dense_mode(native_single):
     mb = flow.query(np.asarray([1, 2, 3], np.uint64))
     direct = g.get_dense_feature(np.asarray(mb.hop_ids[1], np.uint64), ["dense2"])
     np.testing.assert_allclose(mb.feats[1], direct)
+
+
+# -- extended query families served natively (graph_engine.cc parity with
+#    the numpy store: node.h:82-145 full/top-k/in-edge neighbors, varlen
+#    features, layerwise sampling) ---------------------------------------
+
+
+def test_degree_sum_parity(native_pair):
+    gn, gp = native_pair
+    for sn, sp in zip(gn.shards, gp.shards):
+        for types in (None, [0], [1]):
+            for in_edges in (False, True):
+                np.testing.assert_array_equal(
+                    sn.degree_sum(ALL_IDS, types, in_edges=in_edges),
+                    sp.degree_sum(ALL_IDS, types, in_edges=in_edges),
+                )
+
+
+def test_full_neighbor_parity(native_pair):
+    gn, gp = native_pair
+    for sn, sp in zip(gn.shards, gp.shards):
+        for types in (None, [0]):
+            for in_edges in (False, True):
+                for sort_by in (None, "id", "weight"):
+                    a = sn.get_full_neighbor(
+                        ALL_IDS, types, in_edges=in_edges, sort_by=sort_by
+                    )
+                    b = sp.get_full_neighbor(
+                        ALL_IDS, types, in_edges=in_edges, sort_by=sort_by
+                    )
+                    for x, y in zip(a, b):
+                        np.testing.assert_array_equal(x, y)
+
+
+def test_top_k_neighbor_parity(native_pair):
+    gn, gp = native_pair
+    for sn, sp in zip(gn.shards, gp.shards):
+        a = sn.get_top_k_neighbor(ALL_IDS, k=2)
+        b = sp.get_top_k_neighbor(ALL_IDS, k=2)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_in_edge_sampling_native(native_pair, rng):
+    gn, gp = native_pair
+    for sn, sp in zip(gn.shards, gp.shards):
+        nbr, w, tt, mask, eidx = sn.sample_neighbor(
+            ALL_IDS, None, 100, rng=rng, in_edges=True
+        )
+        full, _, _, fmask, _ = sp.get_full_neighbor(ALL_IDS, in_edges=True)
+        for i in range(len(ALL_IDS)):
+            assert set(np.unique(nbr[i][mask[i]])) <= set(
+                full[i][fmask[i]].tolist()
+            )
+
+
+def test_sparse_feature_parity(native_pair):
+    gn, gp = native_pair
+    ids = np.asarray([1, 999, 4, 6], np.uint64)
+    for sn, sp in zip(gn.shards, gp.shards):
+        for max_len in (None, 3):
+            a = sn.get_sparse_feature(ids, ["sp"], max_len=max_len)
+            b = sp.get_sparse_feature(ids, ["sp"], max_len=max_len)
+            for (va, ma), (vb, mb) in zip(a, b):
+                np.testing.assert_array_equal(va, vb)
+                np.testing.assert_array_equal(ma, mb)
+
+
+def test_binary_feature_parity(native_pair):
+    gn, gp = native_pair
+    ids = np.asarray([2, 999, 5], np.uint64)
+    for sn, sp in zip(gn.shards, gp.shards):
+        assert sn.get_binary_feature(ids, ["blob"]) == sp.get_binary_feature(
+            ids, ["blob"]
+        )
+
+
+def test_edge_feature_parity(native_pair):
+    gn, gp = native_pair
+    eids = np.asarray(
+        [[1, 2, 0], [3, 4, 0], [9, 9, 0], [6, 2, 1]], np.uint64
+    )
+    for sn, sp in zip(gn.shards, gp.shards):
+        a = sn.get_edge_sparse_feature(eids, ["e_sp"])
+        b = sp.get_edge_sparse_feature(eids, ["e_sp"])
+        for (va, ma), (vb, mb) in zip(a, b):
+            np.testing.assert_array_equal(va, vb)
+            np.testing.assert_array_equal(ma, mb)
+        np.testing.assert_allclose(
+            sn.get_edge_dense_feature(eids, ["e_dense"]),
+            sp.get_edge_dense_feature(eids, ["e_dense"]),
+        )
+
+
+def test_layerwise_native(native_single, rng):
+    g = native_single
+    s = g.shards[0]
+    layer, adj, lmask = s.sample_neighbor_layerwise(ALL_IDS, count=8, rng=rng)
+    assert layer.shape == (8,) and adj.shape == (6, 8)
+    # sampled layer nodes are real neighbors of the batch, adjacency weights
+    # match the true edge weights into sampled candidates
+    full, w, _, fmask, _ = s.get_full_neighbor(ALL_IDS)
+    all_nbrs = set(full[fmask].tolist())
+    assert set(layer[lmask].tolist()) <= all_nbrs
+    for i in range(6):
+        for j in np.nonzero(lmask)[0]:
+            if adj[i, j] > 0:
+                hits = (full[i] == layer[j]) & fmask[i]
+                assert adj[i, j] == pytest.approx(w[i][hits].sum())
+
+
+def test_native_no_fallback_in_train_queries(native_single):
+    """The serving-path query families all hit the engine (op_stats moves)."""
+    g = native_single
+    s = g.shards[0]
+    s.reset_op_stats()
+    s.get_full_neighbor(ALL_IDS)
+    s.degree_sum(ALL_IDS)
+    s.sample_neighbor_layerwise(ALL_IDS, count=4)
+    s.get_sparse_feature(ALL_IDS, ["sp"])
+    s.get_binary_feature(ALL_IDS, ["blob"])
+    st = s.op_stats()
+    assert st["full_neighbor"]["calls"] >= 1
+    assert st["degree_sum"]["calls"] >= 2  # full_neighbor caps via degree_sum
+    assert st["layerwise"]["calls"] >= 1
+    assert st["varlen_feature"]["calls"] >= 2

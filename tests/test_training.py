@@ -191,3 +191,104 @@ def test_unsupervised_training(cluster_graph, tmp_path):
     )
     history = est.train()
     assert history[-1] < history[0], (history[0], history[-1])
+
+
+def test_scan_training_matches_sequential(cluster_graph, tmp_path):
+    """steps_per_call=K (lax.scan multi-step dispatch) must produce the same
+    params as K sequential single-step dispatches over the same batches."""
+    from euler_tpu.estimator import stack_batches
+
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        cluster_graph, ["feat"], fanouts=[3, 2], label_feature="label", rng=rng
+    )
+    # one fixed sequence of batches, replayed for both runs
+    roots = [
+        cluster_graph.sample_node(8, rng=np.random.default_rng(s))
+        for s in range(8)
+    ]
+    batches = [(flow.query(r),) for r in roots]
+
+    def replay(seq):
+        it = iter(seq)
+        return lambda: next(it)
+
+    model = SuperviseModel(conv="gcn", dims=[8, 8], label_dim=2)
+    cfg1 = EstimatorConfig(
+        model_dir=str(tmp_path / "a"), learning_rate=0.05, log_steps=10**9
+    )
+    est1 = Estimator(model, lambda: batches[0], cfg1)
+    est1._ensure_init()
+    est1.batch_fn = replay(list(batches))
+    h1 = est1.train(total_steps=8, save=False)
+
+    cfg2 = EstimatorConfig(
+        model_dir=str(tmp_path / "b"),
+        learning_rate=0.05,
+        log_steps=10**9,
+        steps_per_call=4,
+    )
+    est2 = Estimator(model, stack_batches(lambda: batches[0], 4), cfg2)
+    est2._ensure_init()
+    est2.batch_fn = stack_batches(replay(list(batches)), 4)
+    h2 = est2.train(total_steps=8, save=False)
+
+    assert len(h2) == 8
+    np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=2e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-5),
+        est1.params,
+        est2.params,
+    )
+
+
+def test_scan_training_remainder_and_exact_steps(cluster_graph, tmp_path):
+    """total_steps not a multiple of steps_per_call still applies exactly
+    total_steps optimizer updates."""
+    from euler_tpu.estimator import stack_batches
+
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        cluster_graph, ["feat"], fanouts=[2], label_feature="label", rng=rng
+    )
+    model = SuperviseModel(conv="gcn", dims=[8], label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "r"),
+        learning_rate=0.05,
+        log_steps=10**9,
+        steps_per_call=4,
+    )
+    est = Estimator(
+        model, stack_batches(node_batches(cluster_graph, flow, 8, rng=rng), 4), cfg
+    )
+    h = est.train(total_steps=10, save=False)
+    assert len(h) == 10
+    assert est.step == 10
+
+
+def test_scan_training_with_mesh(cluster_graph, tmp_path):
+    """steps_per_call>1 under a data mesh shards axis 1 (batch), not the
+    scan axis."""
+    from euler_tpu.estimator import stack_batches
+    from euler_tpu.parallel import make_mesh
+
+    mesh = make_mesh(4)
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        cluster_graph, ["feat"], fanouts=[2], label_feature="label", rng=rng
+    )
+    model = SuperviseModel(conv="gcn", dims=[8], label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "m"),
+        learning_rate=0.05,
+        log_steps=10**9,
+        steps_per_call=2,
+    )
+    est = Estimator(
+        model,
+        stack_batches(node_batches(cluster_graph, flow, 8, rng=rng), 2),
+        cfg,
+        mesh=mesh,
+    )
+    h = est.train(total_steps=6, save=False)
+    assert len(h) == 6 and np.isfinite(h).all()
