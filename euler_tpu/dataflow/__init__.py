@@ -2,6 +2,7 @@ from euler_tpu.dataflow.base import Block, DataFlow, MiniBatch, fanout_block  # 
 from euler_tpu.dataflow.sage import FullNeighborDataFlow, SageDataFlow  # noqa: F401
 from euler_tpu.dataflow.walk import gen_pair  # noqa: F401
 from euler_tpu.dataflow.whole import (  # noqa: F401
+    FullGraphFlow,
     GraphBatch,
     WholeGraphDataFlow,
     graph_label_batches,

@@ -33,6 +33,13 @@ class Block:
     # >0 when edges are grid-structured (dst row i owns slots [i*g, (i+1)*g));
     # unlocks the fused Pallas gather+reduce path
     grid: int = flax.struct.field(pytree_node=False, default=0)
+    # optional TRUE graph degrees (f32, self-loop not included): full-graph
+    # degrees of the src/dst hop's nodes, for exact GCN symmetric
+    # normalization in full-neighbor/whole-graph flows (the reference
+    # computes in-batch degrees, gcn_conv.py:32-54, which only equal true
+    # degrees when every incident edge is present in the block)
+    src_deg: Array | None = None  # f32[n_src]
+    dst_deg: Array | None = None  # f32[n_dst]
 
 
 @flax.struct.dataclass
@@ -52,6 +59,10 @@ class MiniBatch:
     root_idx: Array
     labels: Array | None = None
     hop_ids: tuple | None = None  # int32 per-hop node ids (for id embeddings)
+    # whole-graph flows: rows of the hop-0 table whose outputs participate
+    # in the loss/metric (labels then has one row per target); None means
+    # every hop-0 row is a target (the sampled-flow contract)
+    target_idx: Array | None = None
 
 
 class DataFlow:

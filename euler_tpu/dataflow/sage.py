@@ -160,13 +160,18 @@ class FullNeighborDataFlow(DataFlow):
         label_dim=None,
         rng=None,
         feature_mode="dense",
+        gcn_norm: bool = False,
     ):
+        """gcn_norm=True attaches each hop's TRUE graph degrees to the
+        blocks (src_deg/dst_deg), so GCNConv runs the exact symmetric
+        normalization instead of the in-batch approximation."""
         super().__init__(
             graph, feature_names, label_feature, label_dim, rng, feature_mode
         )
         self.edge_types = edge_types
         self.num_hops = num_hops
         self.max_degree = max_degree
+        self.gcn_norm = gcn_norm
 
     def query(self, roots: np.ndarray) -> MiniBatch:
         roots = np.asarray(roots, dtype=np.uint64)
@@ -182,6 +187,17 @@ class FullNeighborDataFlow(DataFlow):
             cur = nbr.reshape(-1)
             hop_ids.append(cur)
             hop_masks.append(mask.reshape(-1))
+        if self.gcn_norm:
+            degs = [
+                np.asarray(
+                    self.graph.degree_sum(ids, self.edge_types), np.float32
+                )
+                for ids in hop_ids
+            ]
+            blocks = [
+                b.replace(dst_deg=degs[h], src_deg=degs[h + 1])
+                for h, b in enumerate(blocks)
+            ]
         feats = tuple(self.node_feats(ids) for ids in hop_ids)
         return MiniBatch(
             feats=feats,

@@ -110,6 +110,97 @@ class WholeGraphDataFlow(DataFlow):
         )
 
 
+class FullGraphFlow(DataFlow):
+    """Full-batch node classification over the ENTIRE graph (transductive).
+
+    The cora-class GCN recipe (examples/gcn: every node + every edge in one
+    batch, loss on the train split only). One node table X[N, F] and one
+    edge Block are built once and reused for all `num_hops` layers —
+    `query(roots)` only swaps which rows carry loss (`target_idx`). With
+    gcn_norm=True the block carries true degrees, so GCNConv runs the exact
+    Â = D̂^-1/2 (A+I) D̂^-1/2 propagation of the GCN paper rather than the
+    sampled-flow in-batch approximation (gcn_conv.py:32-54).
+    """
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        label_feature: str,
+        num_hops: int = 2,
+        edge_types=None,
+        gcn_norm: bool = True,
+        rng=None,
+    ):
+        super().__init__(graph, feature_names, label_feature, rng=rng)
+        self.num_hops = num_hops
+        # global sorted node table: all shard ids, one row per node
+        ids = np.sort(
+            np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+        ).astype(np.uint64)
+        self.ids = ids
+        self.X = self.node_feats(ids)
+        self.Y = graph.get_dense_feature(ids, [label_feature])
+        # full (directed) edge list mapped to table rows
+        srcs, dsts, ws = [], [], []
+        for s in graph.shards:
+            keep = (
+                np.isin(np.asarray(s.edge_types), list(edge_types))
+                if edge_types is not None
+                else slice(None)
+            )
+            srcs.append(np.asarray(s.edge_src)[keep])
+            dsts.append(np.asarray(s.edge_dst)[keep])
+            ws.append(np.asarray(s.edge_weights)[keep])
+        n = len(ids)
+
+        def rows_of(vals):  # id → table row, verified (dangling → -1)
+            pos = np.clip(np.searchsorted(ids, vals), 0, n - 1)
+            return np.where(ids[pos] == vals, pos, -1).astype(np.int32)
+
+        src = rows_of(np.concatenate(srcs))
+        dst = rows_of(np.concatenate(dsts))
+        ok = (src >= 0) & (dst >= 0)  # drop edges with dangling endpoints
+        src, dst = src[ok], dst[ok]
+        w = np.concatenate(ws).astype(np.float32)[ok]
+        deg = np.asarray(
+            graph.degree_sum(ids, edge_types), np.float32
+        )
+        self.block = Block(
+            edge_src=src,
+            edge_dst=dst,
+            edge_w=w,
+            mask=np.ones(len(src), dtype=bool),
+            n_src=n,
+            n_dst=n,
+            src_deg=deg if gcn_norm else None,
+            dst_deg=deg if gcn_norm else None,
+        )
+        self._ones = np.ones(n, dtype=bool)
+
+    def query(self, roots: np.ndarray) -> "MiniBatch":
+        from euler_tpu.dataflow.base import MiniBatch
+
+        roots = np.asarray(roots, dtype=np.uint64)
+        rows = np.clip(np.searchsorted(self.ids, roots), 0, len(self.ids) - 1)
+        missing = self.ids[rows] != roots
+        if missing.any():
+            raise ValueError(
+                f"{int(missing.sum())} root id(s) not in the graph "
+                f"(e.g. {roots[missing][:3].tolist()})"
+            )
+        rows = rows.astype(np.int32)
+        k = self.num_hops
+        return MiniBatch(
+            feats=(self.X,) * (k + 1),
+            masks=(self._ones,) * (k + 1),
+            blocks=(self.block,) * k,
+            root_idx=rows,
+            labels=self.Y[rows],
+            target_idx=rows,
+        )
+
+
 def graph_label_batches(graph, flow: WholeGraphDataFlow, batch_size: int, rng=None):
     """Training source: sampled graph labels → whole-graph batches
     (graph_estimator parity)."""

@@ -983,10 +983,13 @@ class Graph:
             ),
         )
 
-    def max_degree(self, ids, edge_types=None, in_edges=False) -> int:
-        degs = self._scatter_gather(
+    def degree_sum(self, ids, edge_types=None, in_edges=False) -> np.ndarray:
+        return self._scatter_gather(
             ids, lambda sh, i: sh.degree_sum(i, edge_types, in_edges)
         )
+
+    def max_degree(self, ids, edge_types=None, in_edges=False) -> int:
+        degs = self.degree_sum(ids, edge_types, in_edges)
         return max(int(np.max(degs, initial=0)), 1)
 
     def get_top_k_neighbor(self, ids, edge_types=None, k=10, in_edges=False):

@@ -47,21 +47,35 @@ class Conv(nn.Module):
 
 
 class GCNConv(Conv):
-    """Symmetric-normalized GCN with implicit self-loops (gcn_conv.py:32-54)."""
+    """Symmetric-normalized GCN with implicit self-loops (gcn_conv.py:32-54).
+
+    When the block carries true graph degrees (src_deg/dst_deg, attached by
+    full-neighbor/whole-graph flows with gcn_norm=True) this is the exact
+    Â = D̂^-1/2 (A+I) D̂^-1/2 propagation of the GCN paper; otherwise it
+    falls back to the reference's in-batch degree approximation.
+    """
 
     use_bias: bool = True
 
     @nn.compact
     def __call__(self, x_dst, x_src, block: Block):
-        deg_dst = degrees(block)  # [n_dst]
-        # in sampled/padded flows each src slot feeds exactly one dst; its
-        # in-batch degree is 1 (+1 self), matching the reference's in-batch
-        # degree computation rather than global degrees
-        norm_dst = jnp.power(deg_dst, -0.5)
-        norm_src = jnp.power(2.0, -0.5)
-        msgs = self.msg(x_src, block) * norm_src
-        aggregated = self.agg_add(msgs, block)
-        h = (aggregated + x_dst) * norm_dst[:, None]
+        if block.dst_deg is not None and block.src_deg is not None:
+            dd = block.dst_deg + 1.0  # +1: implicit self loop
+            ds = block.src_deg + 1.0
+            norm_e = jnp.power(
+                gather(ds, block.edge_src) * gather(dd, block.edge_dst), -0.5
+            )
+            msgs = self.msg(x_src, block) * norm_e[:, None]
+            h = self.agg_add(msgs, block) + x_dst / dd[:, None]
+        else:
+            deg_dst = degrees(block)  # [n_dst]
+            # in sampled/padded flows each src slot feeds exactly one dst;
+            # its in-batch degree is 1 (+1 self), matching the reference's
+            # in-batch degree computation rather than global degrees
+            norm_dst = jnp.power(deg_dst, -0.5)
+            norm_src = jnp.power(2.0, -0.5)
+            msgs = self.msg(x_src, block) * norm_src
+            h = (self.agg_add(msgs, block) + x_dst) * norm_dst[:, None]
         return nn.Dense(dtype=self.dtype, features=self.out_dim, use_bias=self.use_bias)(h)
 
 

@@ -37,6 +37,9 @@ class SuperviseModel(nn.Module):
 
     def __call__(self, batch: MiniBatch):
         emb = self.embed(batch)
+        if batch.target_idx is not None:
+            # whole-graph flows: only the target rows carry loss/metric
+            emb = emb[batch.target_idx]
         logits = self.out(emb)
         labels = batch.labels
         loss = optax.sigmoid_binary_cross_entropy(logits, labels)

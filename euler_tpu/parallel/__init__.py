@@ -11,3 +11,8 @@ from euler_tpu.parallel.mesh import (  # noqa: F401
 )
 from euler_tpu.parallel import multihost  # noqa: F401
 from euler_tpu.parallel.sp import sp_segment_mean, sp_segment_sum  # noqa: F401
+from euler_tpu.parallel.embedding import (  # noqa: F401
+    ShardedEmbeddingTable,
+    sharded_lookup,
+    table_sharding,
+)

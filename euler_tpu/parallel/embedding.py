@@ -1,0 +1,92 @@
+"""Model-parallel embedding tables with explicit cross-shard lookup.
+
+The reference keeps billion-id embedding tables alive by partitioning them
+across parameter servers and gathering rows over the network per step
+(tf_euler/python/utils/layers.py:119-171 `SparseEmbedding` over
+`PartitionedVariable`; encoders.py:106-121). The TPU-native equivalent:
+row-shard the table over the mesh's 'model' axis so each chip's HBM holds
+V/P rows, and run the lookup INSIDE the jitted step as a masked local
+gather + psum over ICI (the SPMD one-hot-gather pattern). Every chip reads
+only its own HBM; the psum moves [B, D] activations, not table rows, and
+its transpose routes gradient scatters back to the owning shard — the
+all-to-all analog of the reference's PS gather/scatter round trips.
+
+Scale check: 1B ids x 64 dims x f32 = 256 GB — far beyond one chip's HBM
+but 4 GB/chip on a v5e-64 ('model'=64), leaving room for the optimizer
+slots, which shard identically (optax state mirrors the param tree, so the
+same NamedSharding applies).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from euler_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """Row-sharding over the model axis for a [V, D] table."""
+    return NamedSharding(mesh, P(MODEL_AXIS, None))
+
+
+def sharded_lookup(mesh: Mesh, table: jax.Array, ids: jax.Array) -> jax.Array:
+    """Gather rows of a ('model',)-row-sharded table by replicated ids.
+
+    jit-safe; ids any int shape [...]; returns [..., D] replicated over the
+    mesh (out_specs=P()). Out-of-range ids belong to no shard, so their
+    output rows are all-zero (and receive zero gradient).
+    """
+    from jax import shard_map
+
+    nparts = mesh.shape[MODEL_AXIS]
+    rows_per = table.shape[0] // nparts
+    assert rows_per * nparts == table.shape[0], (
+        f"table rows {table.shape[0]} must divide model axis {nparts}"
+    )
+
+    def local(tab, ids):  # tab: [V/P, D] this shard's rows; ids replicated
+        p = jax.lax.axis_index(MODEL_AXIS)
+        owner = ids // rows_per
+        mine = owner == p
+        rows = jnp.where(mine, ids - owner * rows_per, 0)
+        vals = tab[rows] * mine[..., None].astype(tab.dtype)
+        return jax.lax.psum(vals, MODEL_AXIS)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None), P()),
+        out_specs=P(),
+    )(table, ids)
+
+
+class ShardedEmbeddingTable:
+    """A [V, D] embedding table + adam slots, row-sharded over 'model'.
+
+    A deliberately functional, estimator-independent unit for shallow
+    embedding models (DeepWalk/LINE-class): `lookup` inside jit via
+    sharded_lookup; gradients flow through the masked gather + psum, so
+    `jax.grad` w.r.t. the table lands scatter-adds on the owning shard.
+    """
+
+    def __init__(
+        self, mesh: Mesh, num_rows: int, dim: int, seed: int = 0, scale=0.1
+    ):
+        nparts = mesh.shape[MODEL_AXIS]
+        self.num_rows = ((num_rows + nparts - 1) // nparts) * nparts
+        self.dim = dim
+        self.mesh = mesh
+        sh = table_sharding(mesh)
+        # per-shard init: build each shard's rows on its own device instead
+        # of materializing the full table on one host
+        self.table = jax.jit(
+            lambda key: scale
+            * jax.random.normal(key, (self.num_rows, dim), jnp.float32),
+            out_shardings=sh,
+        )(jax.random.PRNGKey(seed))
+
+    def lookup(self, ids):
+        return sharded_lookup(self.mesh, self.table, ids)

@@ -113,3 +113,70 @@ def test_replicated_matches_single_device():
     l1 = one_loss(None)
     l2 = one_loss(make_mesh(8))
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_sharded_embedding_lookup_and_grad():
+    """Model-axis row-sharded table: masked-gather+psum lookup matches a
+    plain gather, and grad scatters to the owning rows (VERDICT item 9 —
+    the billion-id table pattern: V/P rows per chip, activations not table
+    rows cross the ICI)."""
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu.parallel import (
+        ShardedEmbeddingTable,
+        make_mesh,
+        sharded_lookup,
+    )
+
+    mesh = make_mesh(8, model=4)
+    t = ShardedEmbeddingTable(mesh, 1000, 16, seed=0)
+    assert t.num_rows == 1000  # divisible by 4 already
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 1000, 32), jnp.int32
+    )
+    out = t.lookup(ids)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(t.table)[np.asarray(ids)], rtol=1e-6
+    )
+
+    def loss(tab):
+        return jnp.sum(sharded_lookup(mesh, tab, ids) ** 2)
+
+    g = jax.grad(loss)(t.table)
+    gref = np.zeros_like(np.asarray(t.table))
+    np.add.at(
+        gref, np.asarray(ids), 2 * np.asarray(t.table)[np.asarray(ids)]
+    )
+    np.testing.assert_allclose(np.asarray(g), gref, rtol=1e-5)
+
+
+def test_sharded_embedding_train_step_keeps_sharding():
+    """One adam step over the sharded table keeps table and slot shardings
+    on the model axis (optimizer state sharded alongside)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from euler_tpu.parallel import ShardedEmbeddingTable, make_mesh, sharded_lookup
+
+    mesh = make_mesh(8, model=4)
+    t = ShardedEmbeddingTable(mesh, 512, 8, seed=1)
+    tx = optax.adam(0.1)
+    opt_state = jax.jit(tx.init)(t.table)
+    ids = jnp.asarray([1, 5, 511, 300], jnp.int32)
+
+    @jax.jit
+    def step(table, opt_state):
+        def loss_fn(tab):
+            return jnp.sum(sharded_lookup(mesh, tab, ids) ** 2)
+
+        g = jax.grad(loss_fn)(table)
+        updates, opt_state = tx.update(g, opt_state)
+        return optax.apply_updates(table, updates), opt_state
+
+    table2, opt2 = step(t.table, opt_state)
+    assert table2.sharding == t.table.sharding
+    mu = opt2[0].mu
+    assert mu.sharding == t.table.sharding, (mu.sharding, t.table.sharding)
+    assert np.isfinite(np.asarray(table2)).all()
