@@ -192,6 +192,12 @@ def run(platform: str) -> tuple[float, dict]:
         graph, ["feat"], fanouts=fanouts, label_feature="label", rng=rng,
         feature_mode="rows", lean=True,
     )
+    # fused Pallas aggregation (auto picks it only where measured faster;
+    # +14% end-to-end vs the scatter path on v5e — ops/PALLAS_BENCH.md)
+    if "EULER_TPU_PALLAS" not in os.environ:
+        from euler_tpu.ops import set_pallas
+
+        set_pallas("auto")
     bf16 = BF16 or (not on_cpu and "--fp32" not in sys.argv)
     conv_kwargs = None
     if bf16:

@@ -134,6 +134,12 @@ class FullGraphFlow(DataFlow):
     ):
         super().__init__(graph, feature_names, label_feature, rng=rng)
         self.num_hops = num_hops
+        if not all(hasattr(s, "node_ids") for s in graph.shards):
+            raise ValueError(
+                "FullGraphFlow needs local shards (it reads the whole node"
+                " and edge tables at construction); for remote graphs use a"
+                " sampled flow or load the data locally"
+            )
         # global sorted node table: all shard ids, one row per node
         ids = np.sort(
             np.concatenate([np.asarray(s.node_ids) for s in graph.shards])

@@ -13,8 +13,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import queue
+import selectors
 import socket
-import socketserver
 import threading
 
 import numpy as np
@@ -30,33 +31,134 @@ def _rng_from(seed) -> np.random.Generator:
     return np.random.default_rng(seed if seed is not None else None)
 
 
-class _Handler(socketserver.BaseRequestHandler):
-    def handle(self):
-        service: GraphService = self.server.service  # type: ignore[attr-defined]
-        sock: socket.socket = self.request
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+class _PoolServer:
+    """Bounded worker-pool TCP server (the reference serves with a fixed
+    set of completion-queue threads, grpc_worker_service.cc:48-96, not a
+    thread per connection).
+
+    One selector thread watches every idle connection; when a connection
+    turns readable it is handed to the pool, where a worker runs the full
+    request cycle — blocking frame read, dispatch (the native engine
+    releases the GIL inside its C++ calls), wire encode (no shared lock) —
+    then parks the connection back on the selector. The protocol is
+    request/response lockstep per connection, so a connection is owned by
+    at most one worker at a time and thread count stays constant no matter
+    how many clients connect.
+    """
+
+    def __init__(self, addr, service, workers: int | None = None):
+        self.service = service
+        self.lsock = socket.create_server(addr, backlog=128)
+        self.lsock.setblocking(False)
+        self.server_address = self.lsock.getsockname()
+        self.num_workers = workers or min(
+            32, max(2, (os.cpu_count() or 1) * 2)
+        )
+        self._sel = selectors.DefaultSelector()
+        self._jobs: queue.SimpleQueue = queue.SimpleQueue()
+        self._park: queue.SimpleQueue = queue.SimpleQueue()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self):
+        self._sel.register(self.lsock, selectors.EVENT_READ, "accept")
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        for _ in range(self.num_workers):
+            w = threading.Thread(target=self._worker, daemon=True)
+            w.start()
+            self._threads.append(w)
+
+    def shutdown(self):
+        self._stop.set()
+        self._wake_w.send(b"x")  # unblock the selector
+        for _ in range(self.num_workers):
+            self._jobs.put(None)  # unblock workers
+
+    def server_close(self):
+        self.lsock.close()
+        self._wake_r.close()
+        self._wake_w.close()
+
+    # -- selector thread ---------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            for key, _ in self._sel.select(timeout=0.5):
+                if key.data == "accept":
+                    try:
+                        conn, _ = self.lsock.accept()
+                    except OSError:
+                        continue
+                    conn.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                    conn.setblocking(True)
+                    self._sel.register(conn, selectors.EVENT_READ, "conn")
+                elif key.data == "wake":
+                    try:
+                        self._wake_r.recv(4096)
+                    except OSError:
+                        pass
+                    while True:  # re-register connections workers parked
+                        try:
+                            conn = self._park.get_nowait()
+                        except queue.Empty:
+                            break
+                        try:
+                            self._sel.register(
+                                conn, selectors.EVENT_READ, "conn"
+                            )
+                        except (OSError, ValueError):
+                            conn.close()
+                else:  # a parked connection has a request pending
+                    self._sel.unregister(key.fileobj)
+                    self._jobs.put(key.fileobj)
+
+    # -- worker threads ------------------------------------------------------
+
+    def _worker(self):
         while True:
-            try:
-                payload = wire.read_frame(sock)
-            except (ConnectionError, OSError):
+            conn = self._jobs.get()
+            if conn is None:
                 return
-            if payload is None:
-                return
-            op, args = wire.decode(payload)
             try:
-                result = service.dispatch(op, args)
-                frame = wire.encode("ok", result)
-            except Exception as e:  # report, keep serving
-                frame = wire.encode("err", [f"{type(e).__name__}: {e}"])
-            try:
-                wire.send_frame(sock, frame)
-            except (ConnectionError, OSError):
-                return
+                alive = self._serve_one(conn)
+            except Exception:
+                # a malformed frame must cost the CONNECTION, not the
+                # worker — a dead worker would silently shrink the pool
+                alive = False
+            if alive:
+                self._park.put(conn)
+                try:
+                    self._wake_w.send(b"x")
+                except OSError:
+                    pass
+            else:
+                conn.close()
 
-
-class _Server(socketserver.ThreadingTCPServer):
-    allow_reuse_address = True
-    daemon_threads = True
+    def _serve_one(self, sock: socket.socket) -> bool:
+        try:
+            payload = wire.read_frame(sock)
+        except (ConnectionError, OSError):
+            return False
+        if payload is None:
+            return False
+        op, args = wire.decode(payload)
+        try:
+            result = self.service.dispatch(op, args)
+            frame = wire.encode("ok", result)
+        except Exception as e:  # report, keep serving
+            frame = wire.encode("err", [f"{type(e).__name__}: {e}"])
+        try:
+            wire.send_frame(sock, frame)
+        except (ConnectionError, OSError):
+            return False
+        return True
 
 
 class GraphService:
@@ -70,26 +172,22 @@ class GraphService:
         host: str = "127.0.0.1",
         port: int = 0,
         registry: Registry | None = None,
+        workers: int | None = None,
     ):
         self.store = store
         self.meta = meta
         self.shard = shard
-        self.server = _Server((host, port), _Handler)
-        self.server.service = self  # type: ignore[attr-defined]
+        self.server = _PoolServer((host, port), self, workers)
         self.host, self.port = self.server.server_address
         self.registry = registry
         self._beat = None
-        self._thread = None
         self._cluster_g = None
         self._cluster_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self):
-        self._thread = threading.Thread(
-            target=self.server.serve_forever, daemon=True
-        )
-        self._thread.start()
+        self.server.start()
         if self.registry is not None:
             self._beat = self.registry.register(
                 self.shard, self.host, self.port

@@ -133,9 +133,35 @@ class GATConv(Conv):
         a_dst = nn.Dense(dtype=self.dtype, features=1, use_bias=False)(h_dst)[:, 0]
         e = gather(a_src, block.edge_src) + gather(a_dst, block.edge_dst)
         e = nn.leaky_relu(e, self.negative_slope)
-        alpha = scatter_softmax(e, block.edge_dst, block.n_dst, mask=block.mask)
-        msgs = gather(h_src, block.edge_src) * alpha[:, None]
-        out = self.agg_add(msgs, block)
+        from euler_tpu.ops import pallas_mode
+
+        mode = pallas_mode()
+        if block.grid and mode != "off":
+            # fused segment-softmax family: attention logits are per-edge
+            # SCALARS (a_src·h per node, gathered), so the softmax is a
+            # cheap [n_dst, grid] op and the only [E, F]-sized work — the
+            # value gather + weighted reduce — runs in the fused DMA
+            # kernel. No [E, F] message tensor is ever materialized.
+            d = block.grid
+            e2 = e.reshape(-1, d)
+            m2 = block.mask.reshape(-1, d)
+            e2 = jnp.where(m2, e2, -1e9)
+            alpha = jax.nn.softmax(e2, axis=1) * m2.astype(e2.dtype)
+            from euler_tpu.ops import gather_weighted_sum
+
+            impl = {"auto": "auto", "pallas": "pallas"}.get(mode, "interpret")
+            out = gather_weighted_sum(
+                h_src.astype(jnp.float32),
+                block.edge_src.reshape(-1, d),
+                alpha.astype(jnp.float32),
+                impl,
+            ).astype(h_dst.dtype)
+        else:
+            alpha = scatter_softmax(
+                e, block.edge_dst, block.n_dst, mask=block.mask
+            )
+            msgs = gather(h_src, block.edge_src) * alpha[:, None]
+            out = self.agg_add(msgs, block)
         # self-attention term so isolated nodes keep their embedding
         return out + h_dst
 

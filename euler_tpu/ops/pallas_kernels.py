@@ -29,35 +29,50 @@ TILE = 8  # dst rows per grid step
 
 
 def _kernel(x_ref, slot_ref, w_ref, out_ref, scratch, sems):
-    d = scratch.shape[0]
+    # scratch [2, d, f] double buffer: row i+1's neighbor-row DMAs are in
+    # flight while row i reduces on the MXU. Statically unrolled (TILE and
+    # d are compile-time), so buffer indices are constants.
+    d = scratch.shape[1]
 
-    def row(i, _):
+    def start(i, buf):
         for j in range(d):
             pltpu.make_async_copy(
-                x_ref.at[slot_ref[i, j]], scratch.at[j], sems.at[j]
+                x_ref.at[slot_ref[i, j]], scratch.at[buf, j], sems.at[buf, j]
             ).start()
+
+    def wait(i, buf):
         for j in range(d):
             pltpu.make_async_copy(
-                x_ref.at[slot_ref[i, j]], scratch.at[j], sems.at[j]
+                x_ref.at[slot_ref[i, j]], scratch.at[buf, j], sems.at[buf, j]
             ).wait()
+
+    start(0, 0)
+    for i in range(TILE):
+        if i + 1 < TILE:
+            start(i + 1, (i + 1) % 2)
+        wait(i, i % 2)
         out_ref[i, :] = jnp.dot(
             w_ref[i, :].reshape(1, d),
-            scratch[:],
+            scratch[i % 2],
             preferred_element_type=jnp.float32,
         )[0]
-        return 0
-
-    jax.lax.fori_loop(0, TILE, row, 0)
 
 
 def _pallas_forward(x, slots, w, interpret: bool):
     n_dst, d = slots.shape
     f = x.shape[1]
+    # feature width padded to the 128-lane register width — narrower or
+    # non-multiple rows fail Mosaic's tiling (observed at f=64 / f=256→ok
+    # after padding), and the DMA copies stay row-aligned
+    padf = (-f) % 128
+    if padf:
+        x = jnp.pad(x, ((0, 0), (0, padf)))
     pad = (-n_dst) % TILE
     if pad:
         slots = jnp.pad(slots, ((0, pad), (0, 0)))
         w = jnp.pad(w, ((0, pad), (0, 0)))
     n = slots.shape[0]
+    fp = f + padf
     out = pl.pallas_call(
         _kernel,
         grid=(n // TILE,),
@@ -67,16 +82,16 @@ def _pallas_forward(x, slots, w, interpret: bool):
             pl.BlockSpec((TILE, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (TILE, f), lambda i: (i, 0), memory_space=pltpu.VMEM
+            (TILE, fp), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((n, f), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n, fp), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((d, f), jnp.float32),
-            pltpu.SemaphoreType.DMA((d,)),
+            pltpu.VMEM((2, d, fp), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, d)),
         ],
         interpret=interpret,
     )(x.astype(jnp.float32), slots, w.astype(jnp.float32))
-    return out[:n_dst]
+    return out[:n_dst, :f]
 
 
 def _reference_forward(x, slots, w):
@@ -84,20 +99,51 @@ def _reference_forward(x, slots, w):
     return jnp.einsum("nd,ndf->nf", w, gathered)
 
 
+# Where the DMA kernel beats XLA's gather+einsum, measured on v5e
+# (ops/PALLAS_BENCH.md has the full grid): the fused kernel wins for wide
+# batches at f ≤ 128 (one lane tile per row); above 128 lanes Mosaic
+# requires 8-row-aligned HBM slices, so single-row gathers don't compile —
+# and XLA is already fastest there anyway.
+_PALLAS_MAX_F = 128
+_PALLAS_MIN_DST = 4096
+
+
+def _pallas_supported(f: int) -> bool:
+    return f <= _PALLAS_MAX_F
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def gather_weighted_sum(x, slots, w, impl: str = "auto"):
     """out[i] = Σ_j w[i,j] · x[slots[i,j]].
 
-    impl: 'pallas' | 'interpret' | 'xla' | 'auto' (pallas on TPU else xla).
+    impl: 'pallas' | 'interpret' | 'xla' | 'auto'. 'auto' picks the DMA
+    kernel only where it measured faster than XLA on TPU (see
+    ops/PALLAS_BENCH.md); an explicit 'pallas' never silently falls back.
     """
     return _forward(x, slots, w, impl)
 
 
 def _forward(x, slots, w, impl):
+    f = x.shape[1]
     if impl == "auto":
-        impl = "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+        on_tpu = jax.devices()[0].platform == "tpu"
+        impl = (
+            "pallas"
+            if on_tpu
+            and _pallas_supported(f)
+            and 64 < f
+            and slots.shape[0] >= _PALLAS_MIN_DST
+            else "xla"
+        )
     if impl == "xla":
         return _reference_forward(x, slots, w)
+    if impl == "pallas" and not _pallas_supported(f):
+        raise ValueError(
+            f"pallas gather_weighted_sum supports feature dim <= "
+            f"{_PALLAS_MAX_F} (Mosaic tiles HBM rows (8, 128); a 1-row "
+            f"slice of a >1-lane-tile table is unaligned); got f={f}. "
+            "Use impl='xla' (faster there anyway, see ops/PALLAS_BENCH.md)."
+        )
     return _pallas_forward(x, slots, w, interpret=(impl == "interpret"))
 
 

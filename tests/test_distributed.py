@@ -303,3 +303,41 @@ def test_remote_gql_conditions(cluster, rng):
     nbr, w, tt, mask = res["nb"]
     if mask.any():
         assert local.condition_mask(nbr[mask], [[("dense2", "gt", 3.0)]]).all()
+
+
+def test_concurrent_clients_bounded_pool(cluster):
+    """Many concurrent clients: every reply correct, and the server's
+    thread count stays at the fixed pool size (the reference serves with a
+    fixed completion-queue pool, grpc_worker_service.cc:48-96 — not a
+    thread per connection)."""
+    import threading
+
+    _, _, services, _, _ = cluster
+    svc = services[0]
+    n_clients, n_calls = 12, 25
+    before = threading.active_count()
+    errs = []
+    ids = np.arange(1, 7, dtype=np.uint64)
+
+    def client():
+        try:
+            sh = RemoteShard(0, [(svc.host, svc.port)])
+            for _ in range(n_calls):
+                rows = sh.lookup(ids)
+                assert rows.shape == (6,)
+                nbr, w, tt, mask, eidx = sh.sample_neighbor(ids, None, 4)
+                assert nbr.shape == (6, 4)
+
+        except Exception as e:  # surface from threads
+            errs.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    # pool didn't grow with the 12 connections (allow registry/heartbeat
+    # slack): the same fixed workers served them all
+    after = threading.active_count()
+    assert after - before <= 2, (before, after)

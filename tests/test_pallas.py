@@ -97,3 +97,44 @@ def test_sage_conv_pallas_path_matches(rng):
     finally:
         ops.set_pallas("off")
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gat_fused_grid_matches_scatter_path(rng):
+    """GATConv's fused segment-softmax path (grid blocks through
+    gather_weighted_sum) must match the generic scatter_softmax path."""
+    import jax
+    import jax.numpy as jnp
+
+    from euler_tpu.layers.conv import GATConv
+
+    n_dst, d, f = 6, 4, 16
+    x_dst = jnp.asarray(rng.normal(size=(n_dst, f)), jnp.float32)
+    x_src = jnp.asarray(rng.normal(size=(n_dst * d, f)), jnp.float32)
+    from euler_tpu.dataflow.base import Block
+
+    mask = rng.random((n_dst * d,)) > 0.3
+    mask[:d] = False  # one fully-masked row
+    grid_block = Block(
+        edge_src=jnp.arange(n_dst * d, dtype=jnp.int32),
+        edge_dst=jnp.repeat(jnp.arange(n_dst, dtype=jnp.int32), d),
+        edge_w=jnp.ones(n_dst * d, jnp.float32),
+        mask=jnp.asarray(mask),
+        n_src=n_dst * d,
+        n_dst=n_dst,
+        grid=d,
+    )
+    flat_block = grid_block.replace(grid=0)
+    layer = GATConv(out_dim=8)
+    params = layer.init(jax.random.PRNGKey(0), x_dst, x_src, grid_block)
+    from euler_tpu.ops import pallas_mode, set_pallas
+
+    prev = pallas_mode()
+    set_pallas("interpret")  # force the fused path through the kernel
+    try:
+        out_grid = layer.apply(params, x_dst, x_src, grid_block)
+    finally:
+        set_pallas(prev)
+    out_flat = layer.apply(params, x_dst, x_src, flat_block)
+    np.testing.assert_allclose(
+        np.asarray(out_grid), np.asarray(out_flat), rtol=2e-5, atol=2e-6
+    )
