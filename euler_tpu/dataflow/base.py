@@ -146,6 +146,32 @@ def fanout_block(
     )
 
 
+def upgrade_lean_host(batch: MiniBatch) -> MiniBatch:
+    """Host-side (numpy) rebuild of a LEAN batch's masks and edge weights,
+    giving it the same pytree structure as a downgraded batch from the
+    same lean flow. Exact for batches that satisfy the lean invariants
+    (unit weights, no id aliasing, no dangling rows) — which is every
+    batch a lean flow actually shipped lean. Lets steps_per_call windows
+    that mix lean and downgraded batches stack instead of crashing."""
+    if not isinstance(batch, MiniBatch) or batch.masks is not None:
+        return batch
+    masks = tuple(
+        (np.asarray(f) > 0)
+        if np.issubdtype(np.asarray(f).dtype, np.integer)
+        else np.ones(np.asarray(f).shape[0], bool)
+        for f in batch.feats
+    )
+    masks = (np.asarray(batch.root_idx) != -1,) + masks[1:]
+    blocks = []
+    for h, b in enumerate(batch.blocks):
+        if b.mask is None:
+            b = b.replace(mask=masks[h + 1].reshape(-1))
+        if b.edge_w is None:
+            b = b.replace(edge_w=np.asarray(b.mask, np.float32))
+        blocks.append(b)
+    return batch.replace(masks=masks, blocks=tuple(blocks))
+
+
 def hydrate_blocks(batch: MiniBatch) -> MiniBatch:
     """Rebuild wire-omitted batch pieces on device (jit-safe).
 

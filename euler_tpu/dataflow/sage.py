@@ -37,6 +37,10 @@ class SageDataFlow(DataFlow):
         self.fanouts = list(fanouts)
         self.lazy_blocks = lazy_blocks or lean
         self.lean = lean
+        # set the first time a batch violates the lean assumptions; from
+        # then on every batch ships full arrays so pytree structure stays
+        # stable across a run (stack_batches / scan-dispatch requirement)
+        self._lean_off = False
 
     @property
     def num_hops(self) -> int:
@@ -57,20 +61,32 @@ class SageDataFlow(DataFlow):
             # hop-0 validity matches the fallback path (any non-default id
             # counts, even if absent from the store — its features are zero)
             hop_masks = [roots != DEFAULT_ID] + list(hop_masks[1:])
-            lean = self.lean
+            lean = self.lean and not self._lean_off
             if lean:
-                # lean hydration rebuilds edge_w as 1.0 and derives hop-0
-                # validity from int32 root_idx; when a batch violates either
-                # assumption (non-unit weights, a valid id truncating to
-                # -1), ship the real arrays for that batch instead of
-                # silently training on wrong values
+                # lean hydration rebuilds edge_w as 1.0 and derives hop>=1
+                # validity from feature row > 0 and hop-0 validity from
+                # int32 root_idx; when a batch violates an assumption
+                # (non-unit weights, a valid id truncating to -1, or a
+                # sampler-valid neighbor whose row is -1 — a dangling edge
+                # dst absent from the node table, which would hydrate as
+                # invalid and skew mean denominators), ship the real arrays
+                # instead of silently training on wrong values. The
+                # downgrade is STICKY: mixed lean/full batches have
+                # different pytree structure, which breaks steps_per_call
+                # stacking and forces jit recompiles.
                 unit_w = all(
                     np.all(w[m] == 1.0)
                     for w, m in zip(hop_w[1:], hop_masks[1:])
                 )
                 root32 = roots.astype(np.int64).astype(np.int32)
                 alias = bool(((root32 == -1) & (roots != DEFAULT_ID)).any())
-                lean = unit_w and not alias
+                dangling = any(
+                    bool(((r.reshape(-1) < 0) & m.reshape(-1)).any())
+                    for r, m in zip(hop_rows[1:], hop_masks[1:])
+                )
+                lean = unit_w and not alias and not dangling
+                if not lean:
+                    self._lean_off = True
             blocks = []
             width = len(roots)
             for k, w, mask in zip(self.fanouts, hop_w[1:], hop_masks[1:]):
@@ -134,8 +150,12 @@ class SageDataFlow(DataFlow):
             blocks=tuple(blocks),
             root_idx=roots.astype(np.int64).astype(np.int32),
             labels=self.labels_of(roots),
+            # a lean-configured flow never ships hop_ids, even for
+            # downgraded batches — so a downgraded batch has the same
+            # pytree structure as an upgrade_lean_host()-hydrated lean one
+            # (steps_per_call windows can mix them)
             hop_ids=None
-            if lean
+            if self.lean
             else tuple(
                 ids.astype(np.int64).astype(np.int32) for ids in hop_ids
             ),

@@ -61,7 +61,10 @@ class _Replica:
         wire.send_frame(sock, wire.encode(op, values))
         payload = wire.read_frame(sock)
         if payload is None:
-            raise RpcError("connection closed")
+            # clean EOF — the server closed this connection (shutdown or
+            # restart): a transport failure, so the caller fails over,
+            # unlike an "err" status which is deterministic
+            raise ConnectionError("connection closed by peer")
         status, result = wire.decode(payload)
         if status == "err":
             raise RpcError(result[0])

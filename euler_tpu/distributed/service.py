@@ -44,6 +44,13 @@ class _PoolServer:
     request/response lockstep per connection, so a connection is owned by
     at most one worker at a time and thread count stays constant no matter
     how many clients connect.
+
+    Fan-out ops (a coordinator issues blocking leaf RPCs to peer shards)
+    run on a SEPARATE coordinator pool: if they shared the main pool, two
+    mutually-dependent servers could each fill every worker with blocked
+    coordinators, leaving no worker to serve the peer's leaf sub-requests
+    — a distributed deadlock. Leaf ops touch only the local store, so the
+    main pool always drains.
     """
 
     def __init__(self, addr, service, workers: int | None = None):
@@ -56,11 +63,21 @@ class _PoolServer:
         )
         self._sel = selectors.DefaultSelector()
         self._jobs: queue.SimpleQueue = queue.SimpleQueue()
+        self._coord_jobs: queue.SimpleQueue = queue.SimpleQueue()
         self._park: queue.SimpleQueue = queue.SimpleQueue()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # no coordinator threads on servers that can never fan out
+        # (single-partition serving, the common case)
+        self.num_coordinators = (
+            max(2, self.num_workers // 2)
+            if getattr(service, "may_coordinate", True)
+            else 0
+        )
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     def start(self):
         self._sel.register(self.lsock, selectors.EVENT_READ, "accept")
@@ -72,17 +89,43 @@ class _PoolServer:
             w = threading.Thread(target=self._worker, daemon=True)
             w.start()
             self._threads.append(w)
+        for _ in range(self.num_coordinators):
+            c = threading.Thread(target=self._coordinator, daemon=True)
+            c.start()
+            self._threads.append(c)
 
     def shutdown(self):
         self._stop.set()
         self._wake_w.send(b"x")  # unblock the selector
         for _ in range(self.num_workers):
             self._jobs.put(None)  # unblock workers
+        for _ in range(self.num_coordinators):
+            self._coord_jobs.put(None)
 
     def server_close(self):
         self.lsock.close()
         self._wake_r.close()
         self._wake_w.close()
+        # close every live connection: a worker blocked in read_frame on an
+        # idle-but-open client socket only returns when the peer hangs up,
+        # so without this the shutdown sentinels are never consumed and
+        # connection sockets leak until process exit
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _close_conn(self, conn):
+        with self._conns_lock:
+            self._conns.discard(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     # -- selector thread ---------------------------------------------------
 
@@ -98,6 +141,8 @@ class _PoolServer:
                         socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
                     )
                     conn.setblocking(True)
+                    with self._conns_lock:
+                        self._conns.add(conn)
                     self._sel.register(conn, selectors.EVENT_READ, "conn")
                 elif key.data == "wake":
                     try:
@@ -114,7 +159,7 @@ class _PoolServer:
                                 conn, selectors.EVENT_READ, "conn"
                             )
                         except (OSError, ValueError):
-                            conn.close()
+                            self._close_conn(conn)
                 else:  # a parked connection has a request pending
                     self._sel.unregister(key.fileobj)
                     self._jobs.put(key.fileobj)
@@ -127,28 +172,50 @@ class _PoolServer:
             if conn is None:
                 return
             try:
-                alive = self._serve_one(conn)
+                disposition = self._serve_one(conn)
             except Exception:
                 # a malformed frame must cost the CONNECTION, not the
                 # worker — a dead worker would silently shrink the pool
-                alive = False
-            if alive:
-                self._park.put(conn)
-                try:
-                    self._wake_w.send(b"x")
-                except OSError:
-                    pass
-            else:
-                conn.close()
+                disposition = "close"
+            self._finish(conn, disposition)
 
-    def _serve_one(self, sock: socket.socket) -> bool:
+    def _coordinator(self):
+        while True:
+            job = self._coord_jobs.get()
+            if job is None:
+                return
+            conn, op, args = job
+            try:
+                disposition = self._respond(conn, op, args)
+            except Exception:
+                disposition = "close"
+            self._finish(conn, disposition)
+
+    def _finish(self, conn, disposition: str):
+        if disposition == "park":
+            self._park.put(conn)
+            try:
+                self._wake_w.send(b"x")
+            except OSError:
+                pass
+        elif disposition == "close":
+            self._close_conn(conn)
+        # "detached": the coordinator pool owns the connection now
+
+    def _serve_one(self, sock: socket.socket) -> str:
         try:
             payload = wire.read_frame(sock)
         except (ConnectionError, OSError):
-            return False
+            return "close"
         if payload is None:
-            return False
+            return "close"
         op, args = wire.decode(payload)
+        if self.service.is_coordinator(op):
+            self._coord_jobs.put((sock, op, args))
+            return "detached"
+        return self._respond(sock, op, args)
+
+    def _respond(self, sock: socket.socket, op, args) -> str:
         try:
             result = self.service.dispatch(op, args)
             frame = wire.encode("ok", result)
@@ -157,8 +224,8 @@ class _PoolServer:
         try:
             wire.send_frame(sock, frame)
         except (ConnectionError, OSError):
-            return False
-        return True
+            return "close"
+        return "park"
 
 
 class GraphService:
@@ -177,6 +244,8 @@ class GraphService:
         self.store = store
         self.meta = meta
         self.shard = shard
+        # _PoolServer reads this before spawning coordinator threads
+        self.may_coordinate = meta.num_partitions > 1
         self.server = _PoolServer((host, port), self, workers)
         self.host, self.port = self.server.server_address
         self.registry = registry
@@ -234,6 +303,12 @@ class GraphService:
             return self._cluster_g
 
     # -- dispatch --------------------------------------------------------
+
+    def is_coordinator(self, op: str) -> bool:
+        """True for ops that fan out to peer shards (blocking leaf RPCs);
+        these must not consume main-pool workers or two mutually-dependent
+        servers can deadlock with every worker waiting on the other."""
+        return op == "sample_fanout" and self.meta.num_partitions > 1
 
     def dispatch(self, op: str, a: list) -> list:
         s = self.store
@@ -343,6 +418,7 @@ def serve_shard(
     port: int = 0,
     registry_path: str | None = None,
     native: bool | None = None,
+    workers: int | None = None,
 ) -> GraphService:
     """Load shard `shard` of the dataset at data_dir and serve it."""
     meta = GraphMeta.load(data_dir)
@@ -364,7 +440,9 @@ def serve_shard(
     else:
         store = GraphStore(meta, arrays, shard)
     registry = Registry(registry_path) if registry_path else None
-    return GraphService(store, meta, shard, host, port, registry).start()
+    return GraphService(
+        store, meta, shard, host, port, registry, workers=workers
+    ).start()
 
 
 def main(argv=None):

@@ -458,7 +458,29 @@ def stack_batches(batch_fn: Callable[[], tuple], k: int) -> Callable[[], tuple]:
 
     def fn():
         batches = [batch_fn() for _ in range(k)]
-        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+        try:
+            return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)
+        except ValueError:
+            # the usual cause: a lean dataflow downgraded mid-window, so
+            # some batches carry masks/edge_w arrays and others None.
+            # Hydrating the lean ones host-side is exact (they satisfied
+            # the lean invariants) and makes the window stackable.
+            from euler_tpu.dataflow.base import upgrade_lean_host
+
+            batches = [
+                tuple(upgrade_lean_host(x) for x in bt) for bt in batches
+            ]
+            try:
+                return jax.tree_util.tree_map(
+                    lambda *xs: np.stack(xs), *batches
+                )
+            except ValueError as e:
+                raise ValueError(
+                    "steps_per_call>1 requires every batch in a window to "
+                    "have identical pytree structure; got a mix that lean "
+                    "hydration could not reconcile (a batch_fn with "
+                    f"varying structure?). Original error: {e}"
+                ) from e
 
     return fn
 

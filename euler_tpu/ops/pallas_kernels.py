@@ -153,14 +153,25 @@ def _fwd(x, slots, w, impl):
 
 def _bwd(impl, res, g):
     x, slots, w = res
-    # dL/dx: scatter-add of w·g into the gathered rows
-    contrib = w[:, :, None] * g[:, None, :]  # [N, D, F]
-    dx = jnp.zeros_like(x).at[slots.reshape(-1)].add(
-        contrib.reshape(-1, x.shape[1])
+    # dL/dx: scatter-add of w·g into the gathered rows. Accumulate in f32
+    # (w is f32, and bf16 scatter-add both loses precision and is a dtype
+    # mismatch JAX will reject), then cast the cotangent back to x.dtype.
+    contrib = (
+        w[:, :, None].astype(jnp.float32) * g[:, None, :].astype(jnp.float32)
+    )  # [N, D, F]
+    dx = (
+        jnp.zeros(x.shape, jnp.float32)
+        .at[slots.reshape(-1)]
+        .add(contrib.reshape(-1, x.shape[1]))
+        .astype(x.dtype)
     )
     # dL/dw: per-slot inner product with g
     gathered = jnp.take(x, slots, axis=0)
-    dw = jnp.einsum("nf,ndf->nd", g, gathered)
+    dw = jnp.einsum(
+        "nf,ndf->nd",
+        g.astype(jnp.float32),
+        gathered.astype(jnp.float32),
+    ).astype(w.dtype)
     return dx, None, dw
 
 

@@ -128,6 +128,61 @@ def test_failover(cluster, tmp_path_factory):
     s_b.stop()
 
 
+def test_concurrent_fanout_no_deadlock(cluster, tmp_path_factory):
+    """Coordinator starvation regression: a fan-out op holds a worker
+    while issuing blocking leaf RPCs to peers. With single-worker main
+    pools on two mutually-dependent servers, concurrent fan-outs to both
+    deadlock unless coordinators run on a separate pool (ADVICE r2)."""
+    import threading
+
+    _, _, _, data, _ = cluster
+    d = tmp_path_factory.mktemp("deadlock")
+    reg = str(d / "reg")
+    s0 = serve_shard(data, 0, registry_path=reg, native=False, workers=1)
+    s1 = serve_shard(data, 1, registry_path=reg, native=False, workers=1)
+    try:
+        roots = np.asarray([1, 2, 3, 4], np.uint64)
+        results: dict[int, object] = {}
+
+        def hit(i, port):
+            shard = RemoteShard(i, [("127.0.0.1", port)])
+            results[i] = shard.fanout_with_rows(roots, None, [3, 2])
+
+        threads = [
+            threading.Thread(target=hit, args=(0, s0.port), daemon=True),
+            threading.Thread(target=hit, args=(1, s1.port), daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), (
+            "fan-out coordinators deadlocked across servers"
+        )
+        for i in (0, 1):
+            hop_ids, _, _, hop_mask, _ = results[i]
+            assert hop_ids[1].shape == (12,)
+            assert hop_mask[1].any()
+    finally:
+        s0.stop()
+        s1.stop()
+
+
+def test_shutdown_closes_connections(cluster):
+    """stop() must proactively close parked client connections so blocked
+    workers unblock and sockets don't leak until process exit (ADVICE r2)."""
+    _, _, _, data, _ = cluster
+    s = serve_shard(data, 0, native=False)
+    shard = RemoteShard(0, [("127.0.0.1", s.port)])
+    assert shard.num_nodes > 0  # connection now parked on the selector
+    sock = shard.replicas[0]._local.sock
+    s.stop()
+    # the server closed its side: our next read sees EOF promptly instead
+    # of hanging until process exit
+    sock.settimeout(5)
+    assert sock.recv(1) == b""
+
+
 def test_server_error_reporting(cluster):
     remote, *_ = cluster
     with pytest.raises(RpcError, match="unknown"):

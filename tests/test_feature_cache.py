@@ -245,3 +245,57 @@ def test_lean_downgrades_on_weighted_graph():
     assert mb.masks is not None  # downgraded: real arrays shipped
     assert mb.blocks[0].edge_w is not None
     assert np.all(mb.blocks[0].edge_w[mb.blocks[0].mask] == 2.0)
+
+
+def test_lean_downgrades_on_dangling_edge():
+    """A sampler-valid neighbor absent from the node table resolves to
+    row -1; lean hydration would mask it invalid and skew mean
+    denominators, so such batches must ship real masks — and the
+    downgrade must be sticky so pytree structure stays stable for
+    steps_per_call stacking (ADVICE r2)."""
+    import numpy as np
+
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.graph import Graph
+
+    nodes = [
+        {"id": i, "type": 0, "weight": 1.0,
+         "features": [{"name": "f", "type": "dense", "value": [float(i)]}]}
+        for i in range(1, 5)
+    ]
+    # node 1's only edge points at id 99, which has no node record
+    edges = [
+        {"src": 1, "dst": 99, "type": 0, "weight": 1.0, "features": []}
+    ] + [
+        {"src": s, "dst": s - 1, "type": 0, "weight": 1.0, "features": []}
+        for s in range(2, 5)
+    ]
+    g = Graph.from_json({"nodes": nodes, "edges": edges})
+    if g.fanout_with_rows(np.asarray([1], np.uint64), None, [2]) is None:
+        import pytest
+
+        pytest.skip("fused fanout unavailable")
+    flow = SageDataFlow(
+        g, ["f"], fanouts=[2], rng=np.random.default_rng(0),
+        feature_mode="rows", lean=True,
+    )
+    # first batch avoids the dangling edge: ships lean
+    lean_mb = flow.query(np.asarray([3], np.uint64))
+    assert lean_mb.masks is None
+    mb = flow.query(np.asarray([1], np.uint64))
+    assert mb.masks is not None  # dangling neighbor → real masks shipped
+    # the sampled neighbor 99 is valid per the sampler despite missing feats
+    assert np.asarray(mb.masks[1]).all()
+    assert mb.hop_ids is None  # lean flow never ships hop_ids
+    # sticky: a later batch with no dangling edges stays downgraded
+    mb2 = flow.query(np.asarray([3], np.uint64))
+    assert mb2.masks is not None
+
+    # a steps_per_call window mixing the lean batch with downgraded ones
+    # must stack: stack_batches hydrates the lean one host-side (exact)
+    from euler_tpu.estimator.estimator import stack_batches
+
+    window = iter([(lean_mb,), (mb,), (mb2,)])
+    stacked = stack_batches(lambda: next(window), 3)()
+    (smb,) = (stacked,) if not isinstance(stacked, tuple) else (stacked[0],)
+    assert smb.masks is not None and smb.masks[0].shape[0] == 3

@@ -75,6 +75,68 @@ def test_bf16_conv_forward(conv):
     assert jnp.isfinite(out.astype(jnp.float32)).all()
 
 
+def test_bf16_gather_weighted_sum_grad_dtypes():
+    """The bwd pass must not scatter f32 into a bf16 zeros buffer (JAX
+    upgrades turn that FutureWarning into an error) and cotangents must
+    match primal dtypes."""
+    import warnings
+
+    from euler_tpu.ops.pallas_kernels import gather_weighted_sum
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 16)), jnp.bfloat16)
+    slots = jnp.asarray(rng.integers(0, 32, (8, 4)), jnp.int32)
+    w = jnp.asarray(rng.random((8, 4)), jnp.float32)
+
+    def loss(x, w):
+        return gather_weighted_sum(x, slots, w, "xla").astype(
+            jnp.float32
+        ).sum()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", FutureWarning)
+        dx, dw = jax.grad(loss, argnums=(0, 1))(x, w)
+    assert dx.dtype == jnp.bfloat16
+    assert dw.dtype == jnp.float32
+    # value check vs f32 reference
+    fx, fw = jax.grad(
+        lambda x, w: loss(x.astype(jnp.float32), w), argnums=(0, 1)
+    )(x.astype(jnp.float32), w)
+    np.testing.assert_allclose(
+        np.asarray(dx, np.float32), np.asarray(fx), rtol=0.05, atol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(dw), np.asarray(fw), rtol=0.05, atol=0.05
+    )
+
+
+def test_bf16_train_step_warning_clean():
+    """Full bf16 train step under FutureWarning-as-error (VERDICT r2 #4)."""
+    import warnings
+
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.models import GraphSAGESupervised
+
+    graph = make_cluster_graph()
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        graph, ["feat"], fanouts=[3, 2], label_feature="label", rng=rng
+    )
+    model = GraphSAGESupervised(
+        dims=[16, 16], label_dim=2, conv_kwargs={"dtype": jnp.bfloat16}
+    )
+    est = Estimator(
+        model,
+        node_batches(graph, flow, 16, rng=rng),
+        EstimatorConfig(model_dir="/tmp/bf16_warn_run", log_steps=10**9),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", FutureWarning)
+        hist = est.train(total_steps=3, log=False, save=False)
+    assert np.isfinite(hist).all()
+
+
 def test_bf16_gnn_training():
     from euler_tpu.dataflow import SageDataFlow
     from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
