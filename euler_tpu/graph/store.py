@@ -54,6 +54,55 @@ def split_hops(n_roots: int, counts, *arrays):
     ]
 
 
+def multi_hop_neighbor(graph, nodes, edge_types_per_hop):
+    """Hop-by-hop unioned receptive field with inter-hop adjacency
+    (get_multi_hop_neighbor parity,
+    tf_euler/python/euler_ops/neighbor_ops.py:698-731).
+
+    edge_types_per_hop: one edge-type filter (list or None) per hop.
+    Returns (nodes_list, adj_list):
+      nodes_list[h]  — u64 deduplicated (ascending) node set of hop h;
+                       nodes_list[0] is the flattened roots as given.
+      adj_list[h]    — weighted COO adjacency from hop-h to hop-(h+1)
+                       nodes as (rows i64, cols i64, vals f32, shape),
+                       rows/cols indexing into the two node sets.
+    Works on any object with the get_full_neighbor surface (local store,
+    partitioned facade, remote shard).
+    """
+    cur = np.asarray(nodes, dtype=np.uint64).reshape(-1)
+    nodes_list = [cur]
+    adj_list = []
+    for et in edge_types_per_hop:
+        if cur.size == 0:
+            nodes_list.append(np.empty(0, np.uint64))
+            adj_list.append(
+                (
+                    np.empty(0, np.int64),
+                    np.empty(0, np.int64),
+                    np.empty(0, np.float32),
+                    (0, 0),
+                )
+            )
+            continue
+        nbr, w, _, mask, _ = graph.get_full_neighbor(cur, et)
+        rows2d = np.broadcast_to(
+            np.arange(len(cur), dtype=np.int64)[:, None], nbr.shape
+        )
+        vals = nbr[mask]
+        uniq, inv = np.unique(vals, return_inverse=True)
+        adj_list.append(
+            (
+                rows2d[mask],
+                inv.astype(np.int64),
+                w[mask].astype(np.float32),
+                (len(cur), len(uniq)),
+            )
+        )
+        nodes_list.append(uniq)
+        cur = uniq
+    return nodes_list, adj_list
+
+
 def _rng(rng) -> np.random.Generator:
     return rng if rng is not None else np.random.default_rng()
 
@@ -389,6 +438,9 @@ class GraphStore:
             mask = np.pad(mask, ((0, 0), (0, pad)))
             eidx = np.pad(eidx, ((0, 0), (0, pad)), constant_values=-1)
         return nbr[:, :k], w[:, :k], tt[:, :k], mask[:, :k], eidx[:, :k]
+
+    def get_multi_hop_neighbor(self, nodes, edge_types_per_hop):
+        return multi_hop_neighbor(self, nodes, edge_types_per_hop)
 
     # ---- layerwise sampling (API_SAMPLE_L, sample_layer_op.cc:83) ------
 
@@ -1036,6 +1088,9 @@ class Graph:
             dst_pos[hit].astype(np.int64),
             w[hit].astype(np.float32),
         )
+
+    def get_multi_hop_neighbor(self, nodes, edge_types_per_hop):
+        return multi_hop_neighbor(self, nodes, edge_types_per_hop)
 
     def fanout_with_rows(self, ids, edge_types, counts, rng=None):
         """Fused multi-hop fanout incl. feature-cache rows — the hot path

@@ -1,1 +1,1 @@
-from euler_tpu.query.gql import Query, run_gql  # noqa: F401
+from euler_tpu.query.gql import Query, register_udf, run_gql, unregister_udf  # noqa: F401

@@ -46,8 +46,39 @@ _SOURCE_OR_TRAVERSE = (
     "sampleNB", "sampleLNB", "outV", "inV", "outE",
 )
 _CMP_OPS = ("gt", "ge", "lt", "le", "eq", "ne", "in_", "not_in")
-_UDFS = {"udf_mean": np.mean, "udf_min": np.min, "udf_max": np.max,
-         "udf_sum": np.sum}
+# feature-aggregation UDFs callable as values(udf_*(feat)); the builtins
+# mirror the kernels the reference registers (euler/core/framework/udf.h:
+# 30-60, mean/min/max) + sum. Extend with register_udf().
+_UDFS = {
+    "udf_mean": lambda b: np.mean(b, axis=1, keepdims=True),
+    "udf_min": lambda b: np.min(b, axis=1, keepdims=True),
+    "udf_max": lambda b: np.max(b, axis=1, keepdims=True),
+    "udf_sum": lambda b: np.sum(b, axis=1, keepdims=True),
+}
+
+
+def register_udf(name: str, fn) -> None:
+    """Register a user feature-aggregation UDF (udf.h:30-60 parity).
+
+    `fn(block)` receives the fetched feature block `f32[n, dim]` and must
+    return `[n]` or `[n, k]`. The aggregation runs client-side over the
+    batched fetch, so one registration covers local, partitioned, and
+    remote graphs alike (the reference runs UDFs on the serving shard
+    because its fetches are per-record; here the fetch is already one
+    vectorized batch, so post-aggregation is a free tail op).
+    """
+    if not name.startswith("udf_"):
+        raise ValueError(f"UDF names must start with 'udf_': {name!r}")
+    if not callable(fn):
+        raise TypeError("fn must be callable")
+    _UDFS[name] = fn
+
+
+def unregister_udf(name: str) -> None:
+    """Remove a user-registered UDF; builtins cannot be removed."""
+    if name in ("udf_mean", "udf_min", "udf_max", "udf_sum"):
+        raise ValueError(f"cannot unregister builtin UDF {name!r}")
+    _UDFS.pop(name, None)
 
 
 def _tokenize(src: str):
@@ -354,9 +385,19 @@ class Query:
                         if isinstance(a, tuple) and a[0] == "()":
                             if a[1] not in _UDFS:
                                 raise ValueError(f"unknown UDF {a[1]!r}")
-                            block = _UDFS[a[1]](
-                                block, axis=1, keepdims=True
-                            ).astype(np.float32)
+                            n_rows = block.shape[0]
+                            block = np.asarray(
+                                _UDFS[a[1]](block), dtype=np.float32
+                            )
+                            if block.ndim == 1:
+                                block = block.reshape(-1, 1)
+                            if block.ndim != 2 or block.shape[0] != n_rows:
+                                raise ValueError(
+                                    f"UDF {a[1]!r} returned shape "
+                                    f"{block.shape}; expected [{n_rows}] or "
+                                    f"[{n_rows}, k] (one row per frontier "
+                                    "node — aggregate over axis=1)"
+                                )
                         cols.append(block)
                     last = np.concatenate(cols, axis=1)
                 else:

@@ -183,6 +183,73 @@ def test_shutdown_closes_connections(cluster):
     assert sock.recv(1) == b""
 
 
+def test_sigkill_failover_mid_training(tmp_path, fixture_graph_dict):
+    """SIGKILL a replica's PROCESS mid-training; the trainer must finish
+    via the surviving replica (rpc_manager.h:66-124 semantics — the
+    socket-close failover test can't catch bugs that only an abrupt
+    process death exposes)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.nn import SuperviseModel
+
+    data = str(tmp_path / "data")
+    convert_json(fixture_graph_dict, data, num_partitions=1)
+    reg = str(tmp_path / "reg")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "euler_tpu.distributed.service",
+                "--data", data, "--shard", "0", "--registry", reg,
+                "--no-native",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        for _ in range(2)
+    ]
+    try:
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            table = Registry(reg).lookup(1)
+            if len(table.get(0, [])) >= 2:
+                break
+            _time.sleep(0.2)
+        else:
+            raise TimeoutError("replicas never registered")
+        remote = connect(registry_path=reg, num_shards=1)
+        remote.shards[0].QUARANTINE_S = 0.5  # fast revival for the test
+        rng = np.random.default_rng(0)
+        flow = SageDataFlow(
+            remote, ["dense2"], fanouts=[2], label_feature="dense3", rng=rng
+        )
+        est = Estimator(
+            SuperviseModel(conv="sage", dims=[8], label_dim=3),
+            node_batches(remote, flow, 4, rng=rng),
+            EstimatorConfig(
+                model_dir=str(tmp_path / "m"), total_steps=3, log_steps=10**9
+            ),
+        )
+        h1 = est.train(log=False, save=False)
+        os.kill(procs[0].pid, signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        # keep training: every remaining step must be served by replica 2
+        est.cfg.total_steps = 8
+        h2 = est.train(log=False, save=False)
+        assert np.isfinite(np.concatenate([h1, h2])).all()
+        assert est.step >= 8
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+
+
 def test_server_error_reporting(cluster):
     remote, *_ = cluster
     with pytest.raises(RpcError, match="unknown"):

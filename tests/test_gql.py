@@ -1,13 +1,34 @@
-"""GQL query-chain tests on the fixture graph (both shard counts)."""
+"""GQL query-chain tests on the fixture graph — both shard counts AND a
+live remote cluster (every step/condition/UDF must survive the wire)."""
 
 import numpy as np
 import pytest
 
-from euler_tpu.query import Query, run_gql
+from euler_tpu.query import Query, register_udf, run_gql
 
 
-@pytest.fixture(params=["graph1", "graph2"])
+@pytest.fixture(scope="module")
+def remote_cluster(tmp_path_factory, fixture_graph_dict):
+    from euler_tpu.distributed import connect, serve_shard
+    from euler_tpu.graph import convert_json
+
+    d = tmp_path_factory.mktemp("gql_remote")
+    data = str(d / "data")
+    convert_json(fixture_graph_dict, data, num_partitions=2)
+    reg = str(d / "reg")
+    services = [
+        serve_shard(data, 0, registry_path=reg, native=False),
+        serve_shard(data, 1, registry_path=reg, native=False),
+    ]
+    yield connect(registry_path=reg, num_shards=2)
+    for s in services:
+        s.stop()
+
+
+@pytest.fixture(params=["graph1", "graph2", "remote"])
 def g(request):
+    if request.param == "remote":
+        return request.getfixturevalue("remote_cluster")
     return request.getfixturevalue(request.param)
 
 
@@ -186,6 +207,56 @@ def test_values_udf(g):
     np.testing.assert_allclose(
         res["f"], [[1.4, 1.2], [2.4, 2.2]], rtol=1e-5
     )
+
+
+@pytest.fixture
+def user_udfs():
+    from euler_tpu.query import unregister_udf
+
+    names = []
+
+    def add(name, fn):
+        register_udf(name, fn)
+        names.append(name)
+
+    yield add
+    for n in names:  # keep the process-global registry test-order-clean
+        unregister_udf(n)
+
+
+def test_register_udf(g, user_udfs):
+    """User-registered UDFs surface through values(udf_*) on local,
+    partitioned, and remote graphs (udf.h:30-60 parity)."""
+    user_udfs("udf_range", lambda b: b.max(axis=1) - b.min(axis=1))
+    user_udfs(
+        "udf_sq_sum", lambda b: (b * b).sum(axis=1, keepdims=True)
+    )
+    res = run_gql(
+        g, "v([1, 2]).values(udf_range(dense3), udf_sq_sum(dense2)).as(f)"
+    )
+    # dense3 = [i+.3, i+.4, i+.5] → range .2; dense2 = [i+.1, i+.2]
+    want = [
+        [0.2, 1.1**2 + 1.2**2],
+        [0.2, 2.1**2 + 2.2**2],
+    ]
+    np.testing.assert_allclose(res["f"], want, rtol=1e-5)
+
+
+def test_register_udf_validation(graph1, user_udfs):
+    from euler_tpu.query import unregister_udf
+
+    with pytest.raises(ValueError, match="udf_"):
+        register_udf("mean2", lambda b: b)
+    with pytest.raises(TypeError):
+        register_udf("udf_x", 42)
+    with pytest.raises(ValueError, match="unknown UDF"):
+        Query("v([1]).values(udf_never_registered(dense2)).as(f)").run(graph1)
+    with pytest.raises(ValueError, match="builtin"):
+        unregister_udf("udf_mean")
+    # a UDF aggregating the wrong axis must fail loudly, not misalign rows
+    user_udfs("udf_bad", lambda b: b.sum(axis=0))
+    with pytest.raises(ValueError, match="udf_bad"):
+        run_gql(graph1, "v([1, 2]).values(udf_bad(dense3)).as(f)")
 
 
 def test_in_list_condition(g):

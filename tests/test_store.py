@@ -240,3 +240,70 @@ class TestMultiShardFusedFanout:
         # -1 rows yield zero features
         got = g.get_dense_by_rows(np.asarray([-1, rows[0]]), ["dense2"])
         assert (got[0] == 0).all()
+
+
+class TestMultiHopNeighbor:
+    """get_multi_hop_neighbor parity (neighbor_ops.py:698-731): unioned
+    per-hop node sets + weighted inter-hop COO adjacency."""
+
+    PAIRS = [  # (src, dst, type, weight) — mirrors the conftest fixture
+        (1, 2, 0, 2.0), (1, 3, 1, 3.0), (2, 3, 0, 1.0), (2, 4, 1, 2.0),
+        (3, 4, 0, 3.0), (3, 1, 1, 1.0), (4, 5, 0, 2.0), (4, 6, 1, 1.0),
+        (5, 6, 0, 3.0), (5, 1, 1, 2.0), (6, 1, 0, 1.0), (6, 2, 1, 3.0),
+    ]
+
+    def _numpy_reference(self, roots, edge_types_per_hop):
+        # parallel edges stay separate COO entries — both this
+        # implementation and the tf_euler reference keep per-edge values
+        # (neighbor_ops.py:720-726); only the NODE set is deduplicated
+        nodes_list = [list(roots)]
+        adj_list = []
+        cur = list(roots)
+        for et in edge_types_per_hop:
+            allowed = set(et) if et is not None else {0, 1}
+            entries = [
+                (r, d, w)
+                for r, u in enumerate(cur)
+                for s, d, t, w in self.PAIRS
+                if s == u and t in allowed
+            ]
+            nxt = sorted({d for _, d, _ in entries})
+            pos = {d: j for j, d in enumerate(nxt)}
+            entries.sort(key=lambda e: (e[0], pos[e[1]]))
+            adj_list.append((
+                [r for r, d, _ in entries],
+                [pos[d] for _, d, _ in entries],
+                [w for *_, w in entries],
+                (len(cur), len(nxt)),
+            ))
+            nodes_list.append(nxt)
+            cur = nxt
+        return nodes_list, adj_list
+
+    @pytest.mark.parametrize("shards", [1, 2])
+    def test_matches_numpy_reference(self, graph1, graph2, shards):
+        g = graph1 if shards == 1 else graph2
+        roots = np.asarray([1, 4], np.uint64)
+        per_hop = [[0], None]
+        nodes, adjs = g.get_multi_hop_neighbor(roots, per_hop)
+        ref_nodes, ref_adjs = self._numpy_reference([1, 4], per_hop)
+        assert len(nodes) == 3 and len(adjs) == 2
+        for got, want in zip(nodes[1:], ref_nodes[1:]):
+            assert got.tolist() == want
+        for (r, c, v, shp), (rr, rc, rv, rshp) in zip(adjs, ref_adjs):
+            assert shp == rshp
+            # canonical order for comparison
+            got = sorted(zip(r.tolist(), c.tolist(), v.tolist()))
+            want = sorted(zip(rr, rc, rv))
+            assert [(a, b) for a, b, _ in got] == [(a, b) for a, b, _ in want]
+            np.testing.assert_allclose(
+                [x for *_, x in got], [x for *_, x in want]
+            )
+
+    def test_empty_frontier(self, graph1):
+        # id 999 does not exist: hop 1 is empty, hop 2 stays empty
+        nodes, adjs = graph1.get_multi_hop_neighbor(
+            np.asarray([999], np.uint64), [None, None]
+        )
+        assert nodes[1].size == 0 and nodes[2].size == 0
+        assert adjs[1][3] == (0, 0)
