@@ -7,7 +7,7 @@ from __future__ import annotations
 import numpy as np
 
 from euler_tpu.dataflow.base import DataFlow, MiniBatch, fanout_block
-from euler_tpu.graph.store import DEFAULT_ID
+from euler_tpu.graph.store import DEFAULT_ID, lean_wire_ok
 
 
 class SageDataFlow(DataFlow):
@@ -46,6 +46,68 @@ class SageDataFlow(DataFlow):
     def num_hops(self) -> int:
         return len(self.fanouts)
 
+    def minibatch(self, batch_size: int, node_type: int = -1) -> MiniBatch:
+        """One training minibatch. Against a remote cluster this is a
+        SINGLE RPC — the server samples roots, runs the fused fanout, and
+        fetches labels next to the data (SampleFanoutWithFeature parity);
+        in-process graphs fall back to sample_node + query(roots), which
+        is already zero-copy there."""
+        remote = getattr(self.graph, "sage_minibatch", None)
+        if remote is not None and self.feature_mode == "rows":
+            res = remote(
+                batch_size,
+                self.edge_types,
+                self.fanouts,
+                label=self.label_feature,
+                node_type=node_type,
+                rng=self.rng,
+                lean=self.lean and not self._lean_off,
+            )
+            if res is not None:
+                return self._from_remote(res)
+        roots = self.graph.sample_node(batch_size, node_type, rng=self.rng)
+        return self.query(roots)
+
+    def _from_remote(self, res: dict) -> MiniBatch:
+        roots = np.asarray(res["roots"], np.uint64)
+        if res["lean"]:
+            widths = [len(roots)]
+            for k in self.fanouts:
+                widths.append(widths[-1] * k)
+            offs = np.cumsum([0] + widths)
+            feats = tuple(
+                res["feats"][offs[i] : offs[i + 1]]
+                for i in range(len(widths))
+            )
+            blocks = []
+            width = len(roots)
+            for k in self.fanouts:
+                blocks.append(
+                    fanout_block(
+                        width, k, None, None,
+                        lazy=True, ship_w=False, ship_mask=False,
+                    )
+                )
+                width *= k
+            return MiniBatch(
+                feats=feats,
+                masks=None,
+                blocks=tuple(blocks),
+                root_idx=roots.astype(np.int64).astype(np.int32),
+                labels=res["labels"],
+                hop_ids=None,
+            )
+        if self.lean:
+            # the server found a lean violation in this batch; downgrade
+            # stays sticky for the same structure-stability reasons as the
+            # local path
+            self._lean_off = True
+        hop_ids, hop_w, _, hop_masks, hop_rows = res["hops"]
+        return self._from_fused(
+            roots, hop_ids, hop_w, hop_masks, hop_rows,
+            labels=res["labels"], have_labels=True,
+        )
+
     def query(self, roots: np.ndarray) -> MiniBatch:
         roots = np.asarray(roots, dtype=np.uint64)
         fused = getattr(self.graph, "fanout_with_rows", None)
@@ -58,98 +120,105 @@ class SageDataFlow(DataFlow):
             # fused path: one native-engine call yields every hop's ids,
             # weights, masks AND feature-cache rows
             hop_ids, hop_w, _, hop_masks, hop_rows = res
-            # hop-0 validity matches the fallback path (any non-default id
-            # counts, even if absent from the store — its features are zero)
-            hop_masks = [roots != DEFAULT_ID] + list(hop_masks[1:])
-            lean = self.lean and not self._lean_off
-            if lean:
-                # lean hydration rebuilds edge_w as 1.0 and derives hop>=1
-                # validity from feature row > 0 and hop-0 validity from
-                # int32 root_idx; when a batch violates an assumption
-                # (non-unit weights, a valid id truncating to -1, or a
-                # sampler-valid neighbor whose row is -1 — a dangling edge
-                # dst absent from the node table, which would hydrate as
-                # invalid and skew mean denominators), ship the real arrays
-                # instead of silently training on wrong values. The
-                # downgrade is STICKY: mixed lean/full batches have
-                # different pytree structure, which breaks steps_per_call
-                # stacking and forces jit recompiles.
-                unit_w = all(
-                    np.all(w[m] == 1.0)
-                    for w, m in zip(hop_w[1:], hop_masks[1:])
+            return self._from_fused(roots, hop_ids, hop_w, hop_masks, hop_rows)
+        # no fused rows → nothing to derive lean masks from: full arrays
+        hop_ids = [roots]
+        hop_masks = [roots != DEFAULT_ID]
+        blocks = []
+        cur = roots
+        for k in self.fanouts:
+            nbr, w, _, mask, _ = self.graph.sample_neighbor(
+                cur, self.edge_types, k, rng=self.rng
+            )
+            blocks.append(
+                fanout_block(len(cur), k, w, mask, lazy=self.lazy_blocks)
+            )
+            cur = nbr.reshape(-1)
+            hop_ids.append(cur)
+            hop_masks.append(mask.reshape(-1))
+        # padded slots hold DEFAULT_ID → feature fetch returns zeros
+        feats = tuple(self.node_feats(ids) for ids in hop_ids)
+        return MiniBatch(
+            feats=feats,
+            masks=tuple(hop_masks),
+            blocks=tuple(blocks),
+            root_idx=roots.astype(np.int64).astype(np.int32),
+            labels=self.labels_of(roots),
+            hop_ids=None
+            if self.lean
+            else tuple(
+                ids.astype(np.int64).astype(np.int32) for ids in hop_ids
+            ),
+        )
+
+    def _from_fused(
+        self,
+        roots: np.ndarray,
+        hop_ids,
+        hop_w,
+        hop_masks,
+        hop_rows,
+        labels=None,
+        have_labels: bool = False,
+    ) -> MiniBatch:
+        # hop-0 validity matches the fallback path (any non-default id
+        # counts, even if absent from the store — its features are zero)
+        hop_masks = [roots != DEFAULT_ID] + list(hop_masks[1:])
+        lean = self.lean and not self._lean_off
+        if lean:
+            # a batch violating a lean invariant (lean_wire_ok) would
+            # silently train on wrong values after on-device hydration, so
+            # it ships full arrays instead. The downgrade is STICKY: mixed
+            # lean/full batches have different pytree structure, which
+            # breaks steps_per_call stacking and forces jit recompiles.
+            lean = lean_wire_ok(roots, hop_w, hop_masks, hop_rows)
+            if not lean:
+                self._lean_off = True
+        blocks = []
+        width = len(roots)
+        for k, w, mask in zip(self.fanouts, hop_w[1:], hop_masks[1:]):
+            blocks.append(
+                fanout_block(
+                    width, k, w, mask,
+                    lazy=self.lazy_blocks,
+                    ship_w=not lean,
+                    ship_mask=not lean,
                 )
-                root32 = roots.astype(np.int64).astype(np.int32)
-                alias = bool(((root32 == -1) & (roots != DEFAULT_ID)).any())
-                dangling = any(
-                    bool(((r.reshape(-1) < 0) & m.reshape(-1)).any())
-                    for r, m in zip(hop_rows[1:], hop_masks[1:])
-                )
-                lean = unit_w and not alias and not dangling
-                if not lean:
-                    self._lean_off = True
-            blocks = []
-            width = len(roots)
-            for k, w, mask in zip(self.fanouts, hop_w[1:], hop_masks[1:]):
-                blocks.append(
-                    fanout_block(
-                        width, k, w, mask,
-                        lazy=self.lazy_blocks,
-                        ship_w=not lean,
-                        ship_mask=not lean,
-                    )
-                )
-                width *= k
-            if self.feature_mode == "rows":
+            )
+            width *= k
+        if self.feature_mode == "rows":
+            feats = tuple(
+                np.where(r >= 0, r + 1, 0).astype(np.int32)
+                for r in hop_rows
+            )
+        elif self.feature_names and hasattr(
+            self.graph, "get_dense_by_rows"
+        ):
+            # reuse the rows the fanout already resolved — no second
+            # per-id lookup pass (the facade splits global rows back to
+            # their owner shards on partitioned graphs)
+            try:
                 feats = tuple(
-                    np.where(r >= 0, r + 1, 0).astype(np.int32)
+                    self.graph.get_dense_by_rows(r, self.feature_names)
                     for r in hop_rows
                 )
-            elif self.feature_names and hasattr(
-                self.graph, "get_dense_by_rows"
-            ):
-                # reuse the rows the fanout already resolved — no second
-                # per-id lookup pass (the facade splits global rows back to
-                # their owner shards on partitioned graphs)
-                try:
+            except RuntimeError as e:
+                # capability gap only (older server / no row space):
+                # fall back to per-id fetch; real failures must surface
+                if "unknown op" in str(e) or "num_nodes" in str(e):
                     feats = tuple(
-                        self.graph.get_dense_by_rows(r, self.feature_names)
-                        for r in hop_rows
+                        self.node_feats(ids) for ids in hop_ids
                     )
-                except RuntimeError as e:
-                    # capability gap only (older server / no row space):
-                    # fall back to per-id fetch; real failures must surface
-                    if "unknown op" in str(e) or "num_nodes" in str(e):
-                        feats = tuple(
-                            self.node_feats(ids) for ids in hop_ids
-                        )
-                    else:
-                        raise
-            else:
-                feats = tuple(self.node_feats(ids) for ids in hop_ids)
+                else:
+                    raise
         else:
-            lean = False  # no fused rows → nothing to derive masks from
-            hop_ids = [roots]
-            hop_masks = [roots != DEFAULT_ID]
-            blocks = []
-            cur = roots
-            for k in self.fanouts:
-                nbr, w, _, mask, _ = self.graph.sample_neighbor(
-                    cur, self.edge_types, k, rng=self.rng
-                )
-                blocks.append(
-                    fanout_block(len(cur), k, w, mask, lazy=self.lazy_blocks)
-                )
-                cur = nbr.reshape(-1)
-                hop_ids.append(cur)
-                hop_masks.append(mask.reshape(-1))
-            # padded slots hold DEFAULT_ID → feature fetch returns zeros
             feats = tuple(self.node_feats(ids) for ids in hop_ids)
         return MiniBatch(
             feats=feats,
             masks=None if lean else tuple(hop_masks),
             blocks=tuple(blocks),
             root_idx=roots.astype(np.int64).astype(np.int32),
-            labels=self.labels_of(roots),
+            labels=labels if have_labels else self.labels_of(roots),
             # a lean-configured flow never ships hop_ids, even for
             # downgraded batches — so a downgraded batch has the same
             # pytree structure as an upgrade_lean_host()-hydrated lean one

@@ -276,6 +276,63 @@ class RemoteShard:
             rows_h,
         )
 
+    def sage_minibatch(
+        self,
+        batch_size,
+        edge_types,
+        counts,
+        label=None,
+        node_type=-1,
+        rng=None,
+        lean=True,
+    ):
+        """Whole training minibatch in ONE RPC: the server samples roots,
+        runs the fused fanout, and fetches labels next to the data
+        (SampleFanoutWithFeature parity,
+        tf_euler/kernels/sample_fanout_with_feature_op.cc). Returns a dict:
+        lean → {"lean": True, "roots", "feats" (int32 rows+1 concat over
+        hops), "labels"}; full → {"lean": False, "roots", "hops":
+        (ids, w, tt, mask, rows) per-hop lists, "labels"}.
+        """
+        counts = [int(c) for c in counts]
+        out = self.call(
+            "sage_minibatch",
+            [
+                int(batch_size),
+                _types(edge_types),
+                counts,
+                label,
+                int(node_type),
+                _seed(rng),
+                bool(lean),
+            ],
+        )
+        if out[-1]:
+            return {
+                "lean": True,
+                "roots": out[0],
+                "feats": out[1],
+                "labels": out[2],
+            }
+        from euler_tpu.graph.store import split_hops
+
+        roots = out[0]
+        ids_h, w_h, tt_h, mask_h, rows_h = split_hops(
+            len(roots), counts, *out[1:6]
+        )
+        return {
+            "lean": False,
+            "roots": roots,
+            "hops": (
+                ids_h,
+                w_h,
+                tt_h,
+                [m.astype(bool) for m in mask_h],
+                rows_h,
+            ),
+            "labels": out[6],
+        }
+
     def get_dense_feature(self, ids, names):
         return self.call(
             "get_dense_feature", [np.asarray(ids, np.uint64), list(names)]

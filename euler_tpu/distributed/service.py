@@ -304,11 +304,13 @@ class GraphService:
 
     # -- dispatch --------------------------------------------------------
 
+    COORDINATOR_OPS = ("sample_fanout", "sage_minibatch")
+
     def is_coordinator(self, op: str) -> bool:
         """True for ops that fan out to peer shards (blocking leaf RPCs);
         these must not consume main-pool workers or two mutually-dependent
         servers can deadlock with every worker waiting on the other."""
-        return op == "sample_fanout" and self.meta.num_partitions > 1
+        return op in self.COORDINATOR_OPS and self.meta.num_partitions > 1
 
     def dispatch(self, op: str, a: list) -> list:
         s = self.store
@@ -332,6 +334,8 @@ class GraphService:
                 np.concatenate(hop_mask).astype(np.uint8),
                 np.concatenate(hop_rows),
             ]
+        if op == "sage_minibatch":
+            return self._sage_minibatch(*a)
         if op == "lookup":
             return [s.lookup(a[0])]
         if op == "node_type":
@@ -409,6 +413,54 @@ class GraphService:
                 s._node2vec_step(a[0], a[1], a[2], a[3], a[4], _rng_from(a[5]))
             ]
         raise ValueError(f"unknown op {op!r}")
+
+    def _sage_minibatch(
+        self, batch_size, edge_types, counts, label, node_type, seed, lean
+    ) -> list:
+        """One-RPC training minibatch: root sampling + fused multi-hop
+        fanout + label fetch, coordinated next to the data.
+
+        The reference's SampleFanoutWithFeature kernel plays the same role
+        (one Execute RPC carries the whole sampled subgraph + features,
+        tf_euler/kernels/sample_fanout_with_feature_op.cc); here the
+        response is additionally LEAN when the batch satisfies the lean
+        invariants (unit edge weights, no dangling feature rows): just the
+        root ids, one int32 feature-row array covering every hop, and the
+        root labels — the minimum bytes a rows-mode trainer needs.
+        """
+        from euler_tpu.graph.store import lean_wire_ok
+
+        g = self._cluster()
+        rng = _rng_from(seed)
+        counts = [int(c) for c in counts]
+        roots = g.sample_node(int(batch_size), int(node_type), rng)
+        res = g.fanout_with_rows(roots, edge_types, counts, rng)
+        if res is None:
+            raise RuntimeError("fused fanout unsupported on this cluster")
+        hop_ids, hop_w, hop_tt, hop_mask, hop_rows = res
+        labels = (
+            g.get_dense_by_rows(np.asarray(hop_rows[0], np.int64), [label])
+            if label
+            else None
+        )
+        if lean and lean_wire_ok(roots, hop_w, hop_mask, hop_rows):
+            feats = np.concatenate(
+                [
+                    np.where(r >= 0, r + 1, 0).astype(np.int32)
+                    for r in hop_rows
+                ]
+            )
+            return [roots, feats, labels, True]
+        return [
+            roots,
+            np.concatenate(hop_ids),
+            np.concatenate(hop_w),
+            np.concatenate(hop_tt),
+            np.concatenate(hop_mask).astype(np.uint8),
+            np.concatenate(hop_rows),
+            labels,
+            False,
+        ]
 
 
 def serve_shard(

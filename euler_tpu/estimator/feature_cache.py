@@ -38,18 +38,37 @@ class DeviceFeatureCache:
         feature_names,
         dtype=jnp.float32,
         sharding=None,
+        stage_chunk_rows: int | None = None,
     ):
+        """stage_chunk_rows: stage the table onto the device in row chunks
+        instead of one transfer — big tables (hundreds of MB) shipped as a
+        single device_put can trip transport limits on proxied/tunneled
+        devices; chunking bounds each transfer."""
         host = graph.dense_feature_table(list(feature_names))
         self.dim = host.shape[1]
         table = np.concatenate(
             [np.zeros((1, self.dim), np.float32), host], axis=0
         )
         table = table.astype(np.dtype(dtype))
-        self.table = (
-            jax.device_put(table, sharding)
-            if sharding is not None
-            else jax.device_put(table)
-        )
+        if stage_chunk_rows and len(table) > stage_chunk_rows:
+            put = (
+                (lambda a: jax.device_put(a, sharding))
+                if sharding is not None
+                else jax.device_put
+            )
+            parts = [
+                put(table[lo : lo + stage_chunk_rows])
+                for lo in range(0, len(table), stage_chunk_rows)
+            ]
+            self.table = jnp.concatenate(parts, axis=0)
+            if sharding is not None:
+                self.table = jax.device_put(self.table, sharding)
+        else:
+            self.table = (
+                jax.device_put(table, sharding)
+                if sharding is not None
+                else jax.device_put(table)
+            )
 
     def gather(self, rows) -> jnp.ndarray:
         """int32 rows (0 = padding) → dense [n, F]; jit-safe."""

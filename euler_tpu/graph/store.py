@@ -54,6 +54,29 @@ def split_hops(n_roots: int, counts, *arrays):
     ]
 
 
+def lean_wire_ok(roots, hop_w, hop_mask, hop_rows) -> bool:
+    """True when a fused-fanout batch satisfies the LEAN-wire invariants:
+    unit edge weights, no valid root id truncating to int32 -1, and no
+    sampler-valid neighbor resolving to a dangling (-1) feature row.
+    Lean hydration (dataflow/base.py hydrate_blocks) rebuilds edge_w as
+    1.0 and derives validity from feature row > 0 / int32 root_idx — a
+    batch violating any invariant would silently train on wrong values,
+    so the ONE definition of the check is shared by the client flow and
+    the serving coordinator."""
+    roots = np.asarray(roots, dtype=np.uint64)
+    unit_w = all(
+        np.all(w.reshape(-1)[m.reshape(-1)] == 1.0)
+        for w, m in zip(hop_w[1:], hop_mask[1:])
+    )
+    root32 = roots.astype(np.int64).astype(np.int32)
+    alias = bool(((root32 == -1) & (roots != DEFAULT_ID)).any())
+    dangling = any(
+        bool(((r.reshape(-1) < 0) & m.reshape(-1)).any())
+        for r, m in zip(hop_rows[1:], hop_mask[1:])
+    )
+    return unit_w and not alias and not dangling
+
+
 def multi_hop_neighbor(graph, nodes, edge_types_per_hop):
     """Hop-by-hop unioned receptive field with inter-hop adjacency
     (get_multi_hop_neighbor parity,
@@ -108,14 +131,26 @@ def _rng(rng) -> np.random.Generator:
 
 
 class _WeightedSampler:
-    """O(log n) vectorized weighted sampling via prefix sums."""
+    """O(log n) vectorized weighted sampling via prefix sums.
+
+    The 8 B/item prefix array is built lazily on first draw — a
+    NativeGraphStore routes sampling to the C++ engine, so its Python
+    twin must not pay cumsum RAM for tables it never samples.
+    """
 
     def __init__(self, weights: np.ndarray):
-        self.cum = np.concatenate(
-            [[0.0], np.cumsum(weights.astype(np.float64))]
-        )
-        self.total = float(self.cum[-1])
-        self.n = len(weights)
+        self._weights = np.asarray(weights)
+        self.total = float(np.sum(self._weights, dtype=np.float64))
+        self.n = len(self._weights)
+        self._cum: np.ndarray | None = None
+
+    @property
+    def cum(self) -> np.ndarray:
+        if self._cum is None:
+            self._cum = np.concatenate(
+                [[0.0], np.cumsum(self._weights, dtype=np.float64)]
+            )
+        return self._cum
 
     def sample(self, count: int, rng) -> np.ndarray:
         if self.n == 0 or self.total <= 0:
@@ -134,8 +169,16 @@ class _CSR:
         self.dst = np.asarray(dst)
         self.w = np.asarray(w)
         self.eidx = np.asarray(eidx)
-        self.cum = np.concatenate([[0.0], np.cumsum(self.w.astype(np.float64))])
+        self._cum = None  # lazy (8 B/edge; native stores never touch it)
         self._dst_sorted = None  # lazy: within-row dst-sorted view for lookups
+
+    @property
+    def cum(self) -> np.ndarray:
+        if self._cum is None:
+            self._cum = np.concatenate(
+                [[0.0], np.cumsum(self.w, dtype=np.float64)]
+            )
+        return self._cum
 
     def degrees(self, rows: np.ndarray) -> np.ndarray:
         return self.indptr[rows + 1] - self.indptr[rows]
@@ -230,21 +273,11 @@ class GraphStore:
         self.edge_dst = np.asarray(arrays["edge_dst"])
         self.edge_types = np.asarray(arrays["edge_types"])
         self.edge_weights = np.asarray(arrays["edge_weights"])
-        # global per-type samplers (Graph::BuildGlobalSampler parity)
-        self._node_samplers = [
-            _WeightedSampler(
-                np.where(self.node_types == t, self.node_weights, 0.0)
-            )
-            for t in range(meta.num_node_types)
-        ]
-        self._node_sampler_all = _WeightedSampler(self.node_weights)
-        self._edge_samplers = [
-            _WeightedSampler(
-                np.where(self.edge_types == t, self.edge_weights, 0.0)
-            )
-            for t in range(meta.num_edge_types)
-        ]
-        self._edge_sampler_all = _WeightedSampler(self.edge_weights)
+        # global per-type samplers (Graph::BuildGlobalSampler parity),
+        # built lazily: the masked-weight copies + prefix sums cost
+        # O(bytes-per-edge) RAM that native-engine stores never need
+        self._samplers_n: dict[int, _WeightedSampler] = {}
+        self._samplers_e: dict[int, _WeightedSampler] = {}
         self._edge_key_index: tuple | None = None  # lexsorted (src,dst,type)
         self._index_mgr = None
         self._edge_index_mgr = None
@@ -263,12 +296,36 @@ class GraphStore:
 
     # ---- global sampling (api.h:44-52 parity) --------------------------
 
+    def _node_sampler(self, node_type: int) -> _WeightedSampler:
+        key = -1 if node_type < 0 else int(node_type)
+        if key >= self.meta.num_node_types:
+            raise IndexError(f"node type {key} out of range")
+        s = self._samplers_n.get(key)
+        if s is None:
+            w = (
+                self.node_weights
+                if key < 0
+                else np.where(self.node_types == key, self.node_weights, 0.0)
+            )
+            s = self._samplers_n[key] = _WeightedSampler(w)
+        return s
+
+    def _edge_sampler(self, edge_type: int) -> _WeightedSampler:
+        key = -1 if edge_type < 0 else int(edge_type)
+        if key >= self.meta.num_edge_types:
+            raise IndexError(f"edge type {key} out of range")
+        s = self._samplers_e.get(key)
+        if s is None:
+            w = (
+                self.edge_weights
+                if key < 0
+                else np.where(self.edge_types == key, self.edge_weights, 0.0)
+            )
+            s = self._samplers_e[key] = _WeightedSampler(w)
+        return s
+
     def sample_node(self, count: int, node_type: int = -1, rng=None) -> np.ndarray:
-        sampler = (
-            self._node_sampler_all
-            if node_type < 0
-            else self._node_samplers[node_type]
-        )
+        sampler = self._node_sampler(node_type)
         rowz = sampler.sample(count, rng)
         if sampler.total <= 0:
             return np.full(count, DEFAULT_ID, dtype=np.uint64)
@@ -276,11 +333,7 @@ class GraphStore:
 
     def sample_edge(self, count: int, edge_type: int = -1, rng=None) -> np.ndarray:
         """Returns [count, 3] uint64 rows of (src, dst, type)."""
-        sampler = (
-            self._edge_sampler_all
-            if edge_type < 0
-            else self._edge_samplers[edge_type]
-        )
+        sampler = self._edge_sampler(edge_type)
         if sampler.total <= 0:
             return np.full((count, 3), DEFAULT_ID, dtype=np.uint64)
         rowz = sampler.sample(count, rng)
@@ -1159,6 +1212,38 @@ class Graph:
             all_rows[offs[i] : offs[i + 1]] for i in range(len(hop_ids))
         ]
         return hop_ids, hop_w, hop_tt, hop_mask, hop_rows
+
+    def sage_minibatch(
+        self,
+        batch_size,
+        edge_types,
+        counts,
+        label=None,
+        node_type=-1,
+        rng=None,
+        lean=True,
+    ):
+        """One-RPC training minibatch on a remote cluster (root sampling +
+        fused fanout + labels, coordinated server-side next to the data).
+        Returns None on in-process graphs — callers fall back to
+        sample_node + fanout_with_rows, which is already zero-copy there.
+        """
+        if not all(hasattr(s, "call") for s in self.shards):
+            return None
+        rng = _rng(rng)
+        pick = int(rng.integers(self.num_shards))
+        try:
+            return self.shards[pick].sage_minibatch(
+                batch_size, edge_types, counts, label, node_type, rng, lean
+            )
+        except RuntimeError as e:
+            if "unknown op" in str(e):
+                # older server without the fused op: honor the documented
+                # None-when-unsupported contract (same compat stance as
+                # fanout_with_rows above) so callers fall back to
+                # sample_node + per-op queries
+                return None
+            raise
 
     def get_dense_by_rows(self, rows, names) -> np.ndarray:
         """Dense features by pre-resolved global rows (-1 → zeros).

@@ -112,6 +112,101 @@ def test_remote_dataflow_training(cluster, tmp_path):
     assert np.isfinite(hist).all()
 
 
+@pytest.fixture(scope="module")
+def unit_cluster(tmp_path_factory, fixture_graph_dict):
+    """2-shard cluster over a unit-weight copy of the fixture graph —
+    the lean wire requires uniform weights."""
+    import copy
+
+    g = copy.deepcopy(fixture_graph_dict)
+    for e in g["edges"]:
+        e["weight"] = 1.0
+    d = tmp_path_factory.mktemp("unit")
+    data = str(d / "data")
+    convert_json(g, data, num_partitions=2)
+    reg = str(d / "reg")
+    services = [
+        serve_shard(data, 0, registry_path=reg, native=False),
+        serve_shard(data, 1, registry_path=reg, native=False),
+    ]
+    local = Graph.load(data, native=False)
+    remote = connect(registry_path=reg, num_shards=2)
+    yield remote, local
+    for s in services:
+        s.stop()
+
+
+def test_sage_minibatch_one_rpc(unit_cluster):
+    """The fused training-batch op: one RPC returns roots + every hop's
+    feature rows + labels, matching what the local lean flow builds."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.dataflow.base import hydrate_blocks
+
+    remote, local = unit_cluster
+    rng = np.random.default_rng(3)
+    flow = SageDataFlow(
+        remote, ["dense2"], fanouts=[3, 2], label_feature="dense3",
+        rng=rng, feature_mode="rows", lean=True,
+    )
+    mb = flow.minibatch(5)
+    assert mb.masks is None and mb.hop_ids is None  # lean wire
+    assert [f.shape[0] for f in mb.feats] == [5, 15, 30]
+    assert mb.labels.shape == (5, 3)
+    assert mb.feats[0].dtype == np.int32
+    # roots the server sampled are real nodes; their rows resolve locally
+    roots = mb.root_idx.astype(np.int64).astype(np.uint64)
+    rows = local.lookup_rows(roots)
+    np.testing.assert_array_equal(mb.feats[0], (rows + 1).astype(np.int32))
+    # labels match a local fetch for the same roots
+    np.testing.assert_allclose(
+        mb.labels, local.get_dense_feature(roots, ["dense3"])
+    )
+    # hydrate on device: masks/edges rebuilt, shapes consistent
+    h = hydrate_blocks(mb)
+    assert all(m.shape == (f.shape[0],) for m, f in zip(h.masks, h.feats))
+    assert h.blocks[0].edge_src.shape == (15,)
+
+
+def test_sage_minibatch_downgrade_on_weighted_graph(
+    tmp_path_factory, fixture_graph_dict
+):
+    """A graph with non-unit edge weights must make the server refuse the
+    lean wire; the client builds the full batch and sticks to it."""
+    import copy
+
+    from euler_tpu.dataflow import SageDataFlow
+
+    g = copy.deepcopy(fixture_graph_dict)
+    for e in g["edges"]:
+        e["weight"] = 2.5
+    d = tmp_path_factory.mktemp("wgt")
+    data = str(d / "data")
+    convert_json(g, data, num_partitions=2)
+    reg = str(d / "reg")
+    services = [
+        serve_shard(data, 0, registry_path=reg, native=False),
+        serve_shard(data, 1, registry_path=reg, native=False),
+    ]
+    try:
+        remote = connect(registry_path=reg, num_shards=2)
+        flow = SageDataFlow(
+            remote, ["dense2"], fanouts=[3], label_feature="dense3",
+            rng=np.random.default_rng(0), feature_mode="rows", lean=True,
+        )
+        mb = flow.minibatch(4)
+        assert flow._lean_off  # sticky downgrade
+        assert mb.masks is not None
+        assert mb.blocks[0].edge_w is not None
+        w = mb.blocks[0].edge_w[mb.blocks[0].mask]
+        assert (w == 2.5).all()
+        # next batch keeps the downgraded structure (stacking-safe)
+        mb2 = flow.minibatch(4)
+        assert mb2.masks is not None
+    finally:
+        for s in services:
+            s.stop()
+
+
 def test_failover(cluster, tmp_path_factory):
     """Two replicas of one shard; killing one must not break queries."""
     _, _, _, data, _ = cluster
