@@ -74,18 +74,48 @@ void ParallelFor(i64 n, i64 grain, const std::function<void(i64, i64)>& fn) {
 struct AliasTable {
   std::vector<double> prob;
   std::vector<i64> alias;
+  std::vector<i64> members;  // uniform weights, subset of items (by type)
   double total = 0.0;
+  i64 n_ = 0;
+  bool uniform_dense = false;  // uniform weights over ALL items: O(1), 0 B
 
   void Build(const f32* w, const i32* types, i32 want_type, i64 n) {
-    std::vector<double> p(n);
+    // Uniform detection first: the common unit-weight graph needs NO
+    // materialized table (16 B/item otherwise — at 10^9 edges that is
+    // the difference between loading and OOM).
+    n_ = n;
     total = 0.0;
+    prob.clear();
+    alias.clear();
+    members.clear();
+    uniform_dense = false;
+    bool uniform = true;
+    f32 w0 = 0.0f;
+    i64 count = 0;
     for (i64 i = 0; i < n; ++i) {
-      p[i] = (want_type < 0 || types[i] == want_type) ? w[i] : 0.0;
-      total += p[i];
+      if (want_type < 0 || types[i] == want_type) {
+        if (!count) w0 = w[i];
+        uniform &= (w[i] == w0);
+        total += w[i];
+        ++count;
+      }
     }
+    if (n == 0 || total <= 0) return;
+    if (uniform) {
+      if (count == n) {
+        uniform_dense = true;
+        return;
+      }
+      members.reserve(count);
+      for (i64 i = 0; i < n; ++i)
+        if (want_type < 0 || types[i] == want_type) members.push_back(i);
+      return;
+    }
+    std::vector<double> p(n);
+    for (i64 i = 0; i < n; ++i)
+      p[i] = (want_type < 0 || types[i] == want_type) ? w[i] : 0.0;
     prob.assign(n, 1.0);
     alias.assign(n, 0);
-    if (n == 0 || total <= 0) return;
     double mean = total / n;
     std::vector<i64> small, large;
     small.reserve(n);
@@ -109,6 +139,15 @@ struct AliasTable {
 
   i64 Sample(SplitMix64& rng, i64 n) const {
     if (n == 0 || total <= 0) return -1;
+    if (uniform_dense) {
+      i64 i = (i64)(rng.uniform() * n_);
+      return i >= n_ ? n_ - 1 : i;
+    }
+    if (!members.empty()) {
+      i64 m = (i64)members.size();
+      i64 i = (i64)(rng.uniform() * m);
+      return members[i >= m ? m - 1 : i];
+    }
     i64 i = (i64)(rng.uniform() * n);
     if (i >= n) i = n - 1;
     return rng.uniform() < prob[i] ? i : alias[i];
@@ -195,24 +234,31 @@ struct Csr {
   const f32* w = nullptr;
   const i64* eidx = nullptr;
   i64 n_rows = 0;
-  std::vector<double> cum;  // [nnz+1] cumulative weights
-  std::vector<i64> dst_row;  // [nnz] local row of each dst (-1 off-shard);
-                             // kills the per-sample id binary search
+  std::vector<double> cum;  // [nnz+1] cumulative weights (non-uniform only)
+  std::vector<i32> dst_row;  // [nnz] local row of each dst (-1 off-shard);
+                             // kills the per-sample id binary search.
+                             // i32: shards are capped at 2^31 nodes (Init
+                             // enforces), halving the per-edge overhead
   bool uniform = false;  // all weights equal → O(1) in-row sampling
+  double w0 = 0.0;  // the uniform weight (RowWeight without cum)
 
   void BuildCum(i64 nnz) {
+    uniform = true;
+    w0 = nnz ? (double)w[0] : 0.0;
+    for (i64 i = 0; i < nnz; ++i) uniform &= (w[i] == w[0]);
+    if (uniform) {
+      cum.clear();  // 8 B/edge saved on the common unit-weight graph
+      return;
+    }
     cum.resize(nnz + 1);
     cum[0] = 0.0;
-    uniform = true;
-    for (i64 i = 0; i < nnz; ++i) {
-      cum[i + 1] = cum[i] + w[i];
-      uniform &= w[i] == w[0];
-    }
+    for (i64 i = 0; i < nnz; ++i) cum[i + 1] = cum[i] + w[i];
   }
 
   i64 Degree(i64 row) const { return indptr[row + 1] - indptr[row]; }
   double RowWeight(i64 row) const {
-    return cum[indptr[row + 1]] - cum[indptr[row]];
+    return uniform ? w0 * (indptr[row + 1] - indptr[row])
+                   : cum[indptr[row + 1]] - cum[indptr[row]];
   }
   // weighted pick of a global element index within row
   i64 SampleInRow(i64 row, SplitMix64& rng) const {
@@ -316,6 +362,7 @@ struct Store {
     edge_types = dir.Get<i32>("edge_types");
     edge_weights = dir.Get<f32>("edge_weights");
     if (!node_ids || !node_types || !node_weights) return false;
+    if (num_nodes >= (i64)1 << 31) return false;  // i32 dst_row contract
     num_node_types = n_node_types;
     num_edge_types = n_edge_types;
     adj.resize(num_edge_types);
@@ -357,7 +404,7 @@ struct Store {
         i64 nnz = c.indptr[num_nodes];
         c.dst_row.resize(nnz);
         ParallelFor(nnz, 65536, [&](i64 lo, i64 hi) {
-          for (i64 i = lo; i < hi; ++i) c.dst_row[i] = Lookup(c.dst[i]);
+          for (i64 i = lo; i < hi; ++i) c.dst_row[i] = (i32)Lookup(c.dst[i]);
         });
       }
     }
