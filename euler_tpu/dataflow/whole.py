@@ -52,6 +52,32 @@ class WholeGraphDataFlow(DataFlow):
         self.edge_types = edge_types
         self.num_labels = len(graph.meta.graph_labels)
         self.label_to_onehot = label_to_onehot
+        # Class extraction (base_graph.py:33 parity — the reference feeds
+        # per-graph CLASS labels to the loss, not graph identity): label
+        # strings ending in "_c<k>" (the converter's graph-label format,
+        # e.g. "g17_c1") classify into k; when every label carries one,
+        # batches are one-hot over the distinct classes. Otherwise each
+        # label is its own class (identity), the legacy behavior.
+        import re
+
+        parsed = [
+            re.search(r"_c(-?\d+)$", s) for s in graph.meta.graph_labels
+        ]
+        uniq = (
+            sorted({int(m.group(1)) for m in parsed})
+            if self.num_labels and all(parsed)
+            else []
+        )
+        if len(uniq) >= 2:  # a single parsed class would silently
+            # broadcast (g, 1) labels against multi-class logits —
+            # degenerate label sets keep the identity mapping instead
+            self.label_class = np.asarray(
+                [uniq.index(int(m.group(1))) for m in parsed], np.int64
+            )
+            self.num_classes = len(uniq)
+        else:
+            self.label_class = np.arange(max(self.num_labels, 1))
+            self.num_classes = max(self.num_labels, 1)
 
     def query(self, label_ids: np.ndarray) -> GraphBatch:
         label_ids = np.asarray(label_ids, dtype=np.int64)
@@ -96,9 +122,12 @@ class WholeGraphDataFlow(DataFlow):
             grid=d,
         )
 
-        labels = np.zeros((g, max(self.num_labels, 1)), dtype=np.float32)
+        labels = np.zeros((g, self.num_classes), dtype=np.float32)
         if self.label_to_onehot:
-            labels[np.arange(g), np.clip(label_ids, 0, self.num_labels - 1)] = 1.0
+            cls = self.label_class[
+                np.clip(label_ids, 0, len(self.label_class) - 1)
+            ]
+            labels[np.arange(g), cls] = 1.0
         return GraphBatch(
             feats=self.node_feats(flat),
             node_mask=node_mask,
@@ -130,8 +159,18 @@ class FullGraphFlow(DataFlow):
         num_hops: int = 2,
         edge_types=None,
         gcn_norm: bool = True,
+        add_self_loops: bool = False,
         rng=None,
     ):
+        """add_self_loops appends one unit-weight (i, i) edge per node
+        (UniqueDataFlow add_self_loops parity, neighbor_dataflow.py:27) —
+        attention-style convs then let every node attend to itself without
+        an architecture-side skip term. It also disables gcn_norm's degree
+        attachment: GCNConv's Â = D̂^-1/2(A+I)D̂^-1/2 already contains the
+        implicit self-loop, so feeding it explicit loops on top would
+        double-count the self term — use one or the other."""
+        if add_self_loops:
+            gcn_norm = False
         super().__init__(graph, feature_names, label_feature, rng=rng)
         self.num_hops = num_hops
         if not all(hasattr(s, "node_ids") for s in graph.shards):
@@ -169,6 +208,11 @@ class FullGraphFlow(DataFlow):
         ok = (src >= 0) & (dst >= 0)  # drop edges with dangling endpoints
         src, dst = src[ok], dst[ok]
         w = np.concatenate(ws).astype(np.float32)[ok]
+        if add_self_loops:
+            loops = np.arange(n, dtype=np.int32)
+            src = np.concatenate([src, loops])
+            dst = np.concatenate([dst, loops])
+            w = np.concatenate([w, np.ones(n, np.float32)])
         deg = np.asarray(
             graph.degree_sum(ids, edge_types), np.float32
         )

@@ -163,7 +163,7 @@ def main(argv=None):
         flow = WholeGraphDataFlow(graph, [feature], max_nodes=16, max_degree=8, rng=rng)
         model = GraphClassifier(
             conv=conv, dims=tuple(dims),
-            num_classes=max(len(graph.meta.graph_labels), 2), pool=pool,
+            num_classes=max(flow.num_classes, 2), pool=pool,
         )
         est = Estimator(
             model, graph_label_batches(graph, flow, args.batch_size, rng=rng),
@@ -253,8 +253,13 @@ def main(argv=None):
             graph, [feature], fanouts=args.fanouts[: args.layers],
             label_feature="label", rng=rng,
         )
+        # the reference's GAT example defaults improved=True (run_gat.py
+        # flags) — without it, zero-valid-neighbor roots in sampled flows
+        # emit zero embeddings
+        conv_kwargs = {"improved": True} if CONV_MODELS[name] == "gat" else None
         model = SuperviseModel(
-            conv=CONV_MODELS[name], dims=dims, label_dim=label_dim
+            conv=CONV_MODELS[name], dims=dims, label_dim=label_dim,
+            conv_kwargs=conv_kwargs,
         )
         est = Estimator(
             model, node_batches(graph, flow, args.batch_size, 0, rng=rng),
@@ -302,7 +307,11 @@ def main(argv=None):
             f"mode {args.mode!r} is not supported for model {name!r}"
         )
     if args.mode == "train":
-        est.train()
+        hist = est.train()
+        if len(hist):
+            print(
+                f"trained {len(hist)} steps; final loss {float(hist[-1]):.4f}"
+            )
     elif args.mode == "train_and_evaluate":
         splits = ds.splits(graph) if ds else {"val": graph.sample_node(64)}
         batches_fn = lambda: id_batches(flow, splits["val"], args.batch_size)[0]  # noqa: E731

@@ -120,23 +120,52 @@ class SAGEConv(Conv):
 
 
 class GATConv(Conv):
-    """Single-head graph attention (gat_conv.py); masked segment softmax."""
+    """Graph attention with masked segment softmax (gat_conv.py).
+
+    improved=True adds the transformed dst embedding to the attention
+    output (gat_conv.py apply_node `improved`). heads>1 runs multi-head
+    attention; concat=True concatenates head outputs (out_dim must divide
+    by heads), else heads are averaged — the reference builds the same
+    thing from head_num parallel single-head convs (examples/gat/gat.py
+    get_conv, head_num=4 concat improved=True for the published score).
+    """
 
     negative_slope: float = 0.2
+    improved: bool = False
+    heads: int = 1
+    concat: bool = True
 
     @nn.compact
     def __call__(self, x_dst, x_src, block: Block):
-        w = nn.Dense(dtype=self.dtype, features=self.out_dim, use_bias=False)
+        if self.concat:
+            if self.out_dim % self.heads:
+                raise ValueError(
+                    f"out_dim {self.out_dim} must divide heads {self.heads}"
+                )
+            per = self.out_dim // self.heads
+        else:
+            per = self.out_dim
+        total = per * self.heads
+        w = nn.Dense(dtype=self.dtype, features=total, use_bias=False)
         h_dst = w(x_dst)
         h_src = w(x_src)
-        a_src = nn.Dense(dtype=self.dtype, features=1, use_bias=False)(h_src)[:, 0]
-        a_dst = nn.Dense(dtype=self.dtype, features=1, use_bias=False)(h_dst)[:, 0]
+        hd = h_dst.reshape(-1, self.heads, per)
+        hs = h_src.reshape(-1, self.heads, per)
+        # params live in f32 (flax convention); compute casts to dtype
+        att_s = self.param(
+            "att_src", nn.initializers.lecun_normal(), (self.heads, per)
+        )
+        att_d = self.param(
+            "att_dst", nn.initializers.lecun_normal(), (self.heads, per)
+        )
+        a_src = jnp.einsum("nhp,hp->nh", hs, att_s.astype(hs.dtype))
+        a_dst = jnp.einsum("nhp,hp->nh", hd, att_d.astype(hd.dtype))
         e = gather(a_src, block.edge_src) + gather(a_dst, block.edge_dst)
-        e = nn.leaky_relu(e, self.negative_slope)
+        e = nn.leaky_relu(e, self.negative_slope)  # [E, heads]
         from euler_tpu.ops import pallas_mode
 
         mode = pallas_mode()
-        if block.grid and mode != "off":
+        if block.grid and mode != "off" and self.heads == 1:
             # fused segment-softmax family: attention logits are per-edge
             # SCALARS (a_src·h per node, gathered), so the softmax is a
             # cheap [n_dst, grid] op and the only [E, F]-sized work — the
@@ -159,11 +188,18 @@ class GATConv(Conv):
         else:
             alpha = scatter_softmax(
                 e, block.edge_dst, block.n_dst, mask=block.mask
+            )  # [E, heads]
+            msgs = gather(hs, block.edge_src) * alpha[:, :, None]
+            out = self.agg_add(
+                msgs.reshape(-1, total), block
+            ).reshape(-1, self.heads, per)
+            out = (
+                out.reshape(-1, total) if self.concat else out.mean(axis=1)
             )
-            msgs = gather(h_src, block.edge_src) * alpha[:, None]
-            out = self.agg_add(msgs, block)
-        # self-attention term so isolated nodes keep their embedding
-        return out + h_dst
+        if not self.improved:
+            return out
+        skip = h_dst if self.concat else hd.mean(axis=1)
+        return out + skip
 
 
 class GINConv(Conv):

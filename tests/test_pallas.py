@@ -33,6 +33,19 @@ def test_interpret_matches_xla(data):
     np.testing.assert_allclose(out_i, out_x, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("f", [64, 256, 512, 200])
+def test_wide_features_chunked_gather(rng, f):
+    """f > 128 rides the two-level 128-lane chunk gather; f=200 also
+    exercises the pad-to-lane-tile path."""
+    n_src, n_dst, d = 30, 11, 5
+    x = jnp.asarray(rng.normal(size=(n_src, f)), jnp.float32)
+    slots = jnp.asarray(rng.integers(0, n_src, size=(n_dst, d)), jnp.int32)
+    w = jnp.asarray(rng.random((n_dst, d)), jnp.float32)
+    out_i = gather_weighted_sum(x, slots, w, "interpret")
+    out_x = gather_weighted_sum(x, slots, w, "xla")
+    np.testing.assert_allclose(out_i, out_x, rtol=1e-4, atol=1e-5)
+
+
 def test_non_tile_multiple(rng):
     # n_dst not divisible by TILE exercises the pad path
     x = jnp.asarray(rng.normal(size=(9, 128)), jnp.float32)
