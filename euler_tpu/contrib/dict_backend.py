@@ -1,0 +1,151 @@
+"""A second, independent graph backend: plain-Python dict store.
+
+The reference ships NebulaGraph as an alternative backend behind the same
+op surface (tf_euler/python/euler_ops/base.py:30-127, kernels/
+nebula_sample_neighbor_op.cc) — proving its ops are store-agnostic. This
+module plays that role for the TPU build: a from-scratch store over Python
+dicts (no shard arrays, no CSR, no C++ engine) that implements just the
+query surface the dataflow/estimator stack needs, registered under the
+`dictdb://` URI scheme. Every model that trains on the native store trains
+unchanged on this one — the `Graph` facade seam is real, not hypothetical.
+
+    from euler_tpu.contrib.dict_backend import register
+    register()
+    g = open_graph("dictdb:///path/to/graph.json")
+    SageDataFlow(g, ...)  # standard stack, third-party store
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from euler_tpu.graph.store import DEFAULT_ID
+
+
+class DictGraph:
+    """Minimal Graph-surface implementation over {id: node-dict} maps.
+
+    Holds the graph exactly as the converter-input JSON describes it:
+    adjacency as per-node lists of (dst, weight, type) tuples, features as
+    per-node dicts — a deliberately different representation from the
+    columnar GraphStore, so tests against it exercise the *contract*, not
+    shared code paths.
+    """
+
+    def __init__(self, graph_json: dict):
+        self.nodes: dict[int, dict] = {}
+        self.adj: dict[int, list[tuple[int, float, int]]] = {}
+        for n in graph_json["nodes"]:
+            nid = int(n["id"])
+            feats = {
+                f["name"]: f["value"]
+                for f in n.get("features", [])
+                if f.get("type") == "dense"
+            }
+            self.nodes[nid] = {
+                "type": int(n.get("type", 0)),
+                "weight": float(n.get("weight", 1.0)),
+                "features": feats,
+            }
+            self.adj[nid] = []
+        for e in graph_json["edges"]:
+            src = int(e["src"])
+            if src in self.adj:
+                self.adj[src].append(
+                    (int(e["dst"]), float(e.get("weight", 1.0)),
+                     int(e.get("type", 0)))
+                )
+        self._ids = np.asarray(sorted(self.nodes), dtype=np.uint64)
+        self._weights = np.asarray(
+            [self.nodes[int(i)]["weight"] for i in self._ids], np.float64
+        )
+        self._types = np.asarray(
+            [self.nodes[int(i)]["type"] for i in self._ids], np.int64
+        )
+
+    # -- the query surface the model stack uses --------------------------
+
+    @property
+    def num_shards(self) -> int:
+        return 1
+
+    def sample_node(self, count: int, node_type: int = -1, rng=None):
+        rng = rng if rng is not None else np.random.default_rng()
+        sel = (
+            np.ones(len(self._ids), bool)
+            if node_type < 0
+            else self._types == node_type
+        )
+        ids, w = self._ids[sel], self._weights[sel]
+        if not len(ids):
+            return np.full(count, DEFAULT_ID, dtype=np.uint64)
+        return rng.choice(ids, size=count, p=w / w.sum())
+
+    def sample_neighbor(
+        self, ids, edge_types=None, count=10, rng=None, in_edges=False
+    ):
+        rng = rng if rng is not None else np.random.default_rng()
+        ids = np.asarray(ids, dtype=np.uint64)
+        n = len(ids)
+        nbr = np.full((n, count), DEFAULT_ID, dtype=np.uint64)
+        w = np.zeros((n, count), np.float32)
+        tt = np.full((n, count), -1, np.int32)
+        mask = np.zeros((n, count), bool)
+        eid = np.full((n, count), -1, np.int64)
+        want = None if edge_types is None else set(int(t) for t in edge_types)
+        for i, nid in enumerate(ids.tolist()):
+            cand = [
+                c
+                for c in self.adj.get(nid, [])
+                if want is None or c[2] in want
+            ]
+            if not cand:
+                continue
+            ws = np.asarray([c[1] for c in cand], np.float64)
+            picks = rng.choice(len(cand), size=count, p=ws / ws.sum())
+            for k, pk in enumerate(picks.tolist()):
+                dst, ew, et = cand[pk]
+                nbr[i, k], w[i, k], tt[i, k], mask[i, k] = dst, ew, et, True
+        return nbr, w, tt, mask, eid
+
+    def get_dense_feature(self, ids, names):
+        ids = np.asarray(ids, dtype=np.uint64)
+        rows = []
+        dim = None
+        for nid in ids.tolist():
+            feats = self.nodes.get(int(nid), {}).get("features", {})
+            vec = []
+            for nm in names:
+                v = feats.get(nm)
+                if v is not None:
+                    vec.extend(float(x) for x in v)
+            rows.append(vec)
+            if vec and dim is None:
+                dim = len(vec)
+        dim = dim or 0
+        out = np.zeros((len(ids), dim), np.float32)
+        for i, vec in enumerate(rows):
+            if len(vec) == dim and dim:
+                out[i] = vec
+        return out
+
+    def node_type(self, ids):
+        ids = np.asarray(ids, dtype=np.uint64)
+        return np.asarray(
+            [self.nodes.get(int(i), {"type": -1})["type"] for i in ids],
+            np.int32,
+        )
+
+
+def _open_dictdb(uri, **kw):
+    path = (uri.netloc + uri.path) if uri.netloc else uri.path
+    with open(path) as f:
+        return DictGraph(json.load(f))
+
+
+def register() -> None:
+    from euler_tpu.graph.backends import register_backend
+
+    register_backend("dictdb", _open_dictdb)

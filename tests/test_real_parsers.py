@@ -1,0 +1,255 @@
+"""Real-dataset ingestion: exercise every raw-file parser on tiny
+hand-built fixtures in the EXACT upstream formats (Planetoid pickles,
+GraphSAGE json/npy, TU text files, KG TSV triples, MovieLens .dat) and run
+build_json → convert → query/train end-to-end — so the real-data path is
+tested code, not dead code (VERDICT r2 missing #5;
+tf_euler/python/dataset/base_dataset.py:49-95 is the reference pipeline).
+"""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from euler_tpu.datasets.catalog import (
+    KGDataset,
+    MovieLensDataset,
+    PlanetoidDataset,
+    SageDataset,
+    TUDataset,
+)
+
+
+# -- fixture writers (raw upstream formats) ------------------------------
+
+
+def write_planetoid(root, name, gaps=False):
+    """ind.<name>.{x,y,tx,ty,allx,ally,graph,test.index} — 3 train, 3
+    other, 2-3 test nodes, 6-dim bag-of-words, 3 classes."""
+    import scipy.sparse as sp
+
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(0)
+    n_all, n_test, dim, ncls = 6, 2, 6, 3
+    allx = sp.csr_matrix((rng.random((n_all, dim)) < 0.4).astype(np.float32))
+    ally = np.eye(ncls, dtype=np.int64)[rng.integers(0, ncls, n_all)]
+    x, y = allx[:3], ally[:3]
+    tx = sp.csr_matrix(
+        (rng.random((n_test, dim)) < 0.4).astype(np.float32)
+    )
+    ty = np.eye(ncls, dtype=np.int64)[rng.integers(0, ncls, n_test)]
+    # graph: adjacency dict over ALL node indices (allx block + test block)
+    if gaps:
+        # citeseer-style: test.index skips an id (isolated node)
+        test_index = np.asarray([n_all, n_all + 2])
+        n_total = n_all + 3
+    else:
+        test_index = np.arange(n_all, n_all + n_test)
+        n_total = n_all + n_test
+    graph = {
+        i: [int(j) for j in rng.choice(n_total, 2, replace=False) if j != i]
+        for i in range(n_total)
+    }
+    blobs = {"x": x, "y": y, "tx": tx, "ty": ty, "allx": allx, "ally": ally,
+             "graph": graph}
+    for part, obj in blobs.items():
+        with open(os.path.join(root, f"ind.{name}.{part}"), "wb") as f:
+            pickle.dump(obj, f)
+    np.savetxt(
+        os.path.join(root, f"ind.{name}.test.index"), test_index, fmt="%d"
+    )
+    return n_total, dim, ncls
+
+
+def write_sage(root, name="ppi"):
+    os.makedirs(root, exist_ok=True)
+    nodes = [
+        {"id": i, "val": i == 3, "test": i == 4} for i in range(5)
+    ]
+    links = [{"source": 0, "target": 1}, {"source": 1, "target": 2},
+             {"source": 2, "target": 3}, {"source": 3, "target": 4}]
+    with open(os.path.join(root, f"{name}-G.json"), "w") as f:
+        json.dump({"nodes": nodes, "links": links}, f)
+    np.save(
+        os.path.join(root, f"{name}-feats.npy"),
+        np.arange(5 * 4, dtype=np.float32).reshape(5, 4),
+    )
+    with open(os.path.join(root, f"{name}-class_map.json"), "w") as f:
+        # ppi is multilabel: list-valued classes
+        json.dump({str(i): [i % 2, 1 - i % 2, 1] for i in range(5)}, f)
+    with open(os.path.join(root, f"{name}-id_map.json"), "w") as f:
+        json.dump({str(i): i for i in range(5)}, f)
+
+
+def write_tu(root, name="mutag"):
+    os.makedirs(root, exist_ok=True)
+    up = name.upper()
+    # graph 1: triangle over nodes 1-3; graph 2: 2-path over nodes 4-6
+    edges = [(1, 2), (2, 1), (2, 3), (3, 2), (1, 3), (3, 1),
+             (4, 5), (5, 4), (5, 6), (6, 5)]
+    with open(os.path.join(root, f"{up}_A.txt"), "w") as f:
+        for s, d in edges:
+            f.write(f"{s}, {d}\n")
+    np.savetxt(
+        os.path.join(root, f"{up}_graph_indicator.txt"),
+        [1, 1, 1, 2, 2, 2], fmt="%d",
+    )
+    np.savetxt(os.path.join(root, f"{up}_graph_labels.txt"), [1, -1], fmt="%d")
+    np.savetxt(
+        os.path.join(root, f"{up}_node_labels.txt"),
+        [0, 1, 2, 0, 0, 1], fmt="%d",
+    )
+
+
+def write_kg(root):
+    os.makedirs(root, exist_ok=True)
+    train = [
+        ("/m/a", "r1", "/m/b"),
+        ("/m/b", "r1", "/m/c"),
+        ("/m/c", "r2", "/m/a"),
+        ("/m/a", "r2", "/m/d"),
+        ("/m/d", "r1", "/m/b"),
+    ]
+    valid = [("/m/a", "r1", "/m/c")]
+    test = [("/m/b", "r2", "/m/d"), ("/m/zzz", "r1", "/m/a")]  # zzz unseen
+    for split, rows in (("train", train), ("valid", valid), ("test", test)):
+        with open(os.path.join(root, f"{split}.txt"), "w") as f:
+            for h, r, t in rows:
+                f.write(f"{h}\t{r}\t{t}\n")
+
+
+def write_ml(root):
+    os.makedirs(root, exist_ok=True)
+    with open(os.path.join(root, "movies.dat"), "w", encoding="latin1") as f:
+        f.write("1::Toy Story (1995)::Animation|Children's|Comedy\n")
+        f.write("2::Heat (1995)::Action|Crime|Thriller\n")
+    with open(os.path.join(root, "users.dat"), "w", encoding="latin1") as f:
+        f.write("1::F::1::10::48067\n")
+        f.write("2::M::56::16::70072\n")
+    with open(os.path.join(root, "ratings.dat"), "w", encoding="latin1") as f:
+        f.write("1::1::5::978300760\n")
+        f.write("1::2::3::978302109\n")
+        f.write("2::1::4::978301968\n")
+
+
+# -- tests ----------------------------------------------------------------
+
+
+def test_planetoid_parser_end_to_end(tmp_path):
+    root = str(tmp_path / "cora")
+    n, dim, ncls = write_planetoid(root, "cora")
+    ds = PlanetoidDataset("cora", root=root)
+    assert ds.raw_present()
+    g = ds.load_graph(synthetic=False)
+    assert sum(s.num_nodes for s in g.shards) == n
+    feats = g.get_dense_feature(
+        np.arange(1, n + 1, dtype=np.uint64), ["feature"]
+    )
+    assert feats.shape == (n, dim)
+    labels = g.get_dense_feature(
+        np.arange(1, n + 1, dtype=np.uint64), ["label"]
+    )
+    assert labels.shape == (n, ncls)
+    assert (labels.sum(axis=1) == 1).all()
+    # end-to-end: full-graph GCN training runs on the parsed graph
+    from euler_tpu.dataflow import FullGraphFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig
+    from euler_tpu.nn import SuperviseModel
+
+    flow = FullGraphFlow(g, ["feature"], "label", num_hops=1)
+    ids = np.arange(1, n + 1, dtype=np.uint64)
+    est = Estimator(
+        SuperviseModel(conv="gcn", dims=[8], label_dim=ncls),
+        lambda: (flow.query(ids),),
+        EstimatorConfig(model_dir=str(tmp_path / "m"), log_steps=10**9),
+    )
+    hist = est.train(total_steps=3, save=False, log=False)
+    assert np.isfinite(hist).all()
+
+
+def test_planetoid_parser_test_index_gaps(tmp_path):
+    """citeseer-style gap in test.index (isolated test nodes) must
+    zero-fill the missing rows, not crash or misalign."""
+    root = str(tmp_path / "citeseer")
+    n, dim, ncls = write_planetoid(root, "citeseer", gaps=True)
+    ds = PlanetoidDataset("citeseer", root=root)
+    g = ds.load_graph(synthetic=False)
+    assert sum(s.num_nodes for s in g.shards) == n
+    # the gap node (index n_all+1 → id n_all+2) exists with zero label
+    labels = g.get_dense_feature(
+        np.asarray([n - 1], dtype=np.uint64), ["label"]
+    )
+    assert labels.shape == (1, ncls)
+
+
+def test_sage_parser(tmp_path):
+    root = str(tmp_path / "ppi")
+    write_sage(root, "ppi")
+    ds = SageDataset("ppi", root=root)
+    g = ds.load_graph(synthetic=False)
+    assert sum(s.num_nodes for s in g.shards) == 5
+    sp = ds.splits(g)
+    assert sp["val"].tolist() == [4] and sp["test"].tolist() == [5]
+    feats = g.get_dense_feature(np.asarray([1, 5], np.uint64), ["feature"])
+    np.testing.assert_allclose(feats[0], np.arange(4, dtype=np.float32))
+    labels = g.get_dense_feature(np.asarray([2], np.uint64), ["label"])
+    np.testing.assert_allclose(labels[0], [1, 0, 1])  # multilabel
+
+
+def test_tu_parser_whole_graph_flow(tmp_path):
+    root = str(tmp_path / "mutag")
+    write_tu(root, "mutag")
+    ds = TUDataset("mutag", root=root)
+    g = ds.load_graph(synthetic=False)
+    assert sum(s.num_nodes for s in g.shards) == 6
+    # graph labels land in the graph-label table; whole-graph fetch works
+    labels = sorted(g.meta.graph_labels)
+    assert labels == ["g1_c1", "g2_c-1"]
+    members = g.get_graph_by_label(
+        np.asarray([g.meta.graph_labels.index("g1_c1")], np.int64)
+    )
+    assert sorted(np.asarray(members[0]).tolist()) == [1, 2, 3]
+    # one-hot node features from node_labels
+    f = g.get_dense_feature(np.asarray([3], np.uint64), ["feature"])
+    np.testing.assert_allclose(f[0], [0, 0, 1])
+
+
+def test_kg_parser_and_eval_filtering(tmp_path):
+    root = str(tmp_path / "fb15k")
+    write_kg(root)
+    ds = KGDataset("fb15k", root=root)
+    g = ds.load_graph(synthetic=False)
+    assert sum(s.num_nodes for s in g.shards) == 4  # a, b, c, d
+    e = g.sample_edge(50, rng=np.random.default_rng(0))
+    assert set(e[:, 2].tolist()) <= {0, 1}
+    test = ds.eval_triples("test")
+    # the /m/zzz triple is filtered (unseen entity)
+    assert test.shape == (1, 3)
+    valid = ds.eval_triples("valid")
+    assert valid.shape == (1, 3)
+    # ids are consistent: valid triple is (a, r1, c)
+    ent = ds.entity_map
+    assert valid[0].tolist() == [ent["/m/a"], 0, ent["/m/c"]]
+
+
+def test_movielens_parser(tmp_path):
+    root = str(tmp_path / "ml_1m")
+    write_ml(root)
+    ds = MovieLensDataset("ml_1m", root=root)
+    g = ds.load_graph(synthetic=False)
+    assert sum(s.num_nodes for s in g.shards) == 4  # 2 movies + 2 users
+    uid = MovieLensDataset.MOVIE_LEN + 1
+    [(vals, mask)] = g.get_sparse_feature(
+        np.asarray([uid], np.uint64), ["gender"], max_len=1
+    )
+    assert vals[0, 0] == 1  # user 1 is F
+    # rating edges carry weight = rating
+    nbr, w, _, mask, _ = g.get_full_neighbor(
+        np.asarray([uid], np.uint64), max_degree=4
+    )
+    got = sorted(
+        (int(n), float(x)) for n, x in zip(nbr[0][mask[0]], w[0][mask[0]])
+    )
+    assert got == [(1, 5.0), (2, 3.0)]
