@@ -1003,6 +1003,48 @@ void etpu_layerwise(void* h, const u64* ids, i64 n, const i32* types,
 }
 
 // Directional weighted neighbor sampling (in_edges=1 draws from in-CSRs).
+// Lean leaf sampling for the distributed fanout hot path: neighbor ids,
+// validity, and the PRE-RESOLVED local row of each picked dst (from the
+// load-time dst_row cache; -1 when the dst lives on another shard). Skips
+// the weight/type/edge-id outputs entirely — the lean wire rebuilds unit
+// weights on device, so shipping them is pure coordinator CPU waste.
+void etpu_sample_neighbor_rows(void* h, const u64* ids, i64 n,
+                               const i32* types, i64 ntypes, i64 count,
+                               u64 seed, u64* nbr, u8* mask, i64* nrow) {
+  auto* s = (Store*)h;
+  ScopedTimer timer(s->stats, kOpSampleNeighbor);
+  std::vector<i32> all_types;
+  if (ntypes == 0) {
+    for (i64 t = 0; t < s->num_edge_types; ++t) all_types.push_back((i32)t);
+    types = all_types.data();
+    ntypes = all_types.size();
+  }
+  ParallelFor(n, 256, [&](i64 lo, i64 hi) {
+    SplitMix64 rng(seed ^ (0x2545f4914f6cdd1dull * (u64)(lo + 1)));
+    std::vector<double> tot(ntypes);
+    for (i64 i = lo; i < hi; ++i) {
+      i64 row = s->Lookup(ids[i]);
+      double total = 0.0;
+      for (i64 k = 0; k < ntypes; ++k) {
+        tot[k] = row < 0 ? 0.0 : s->adj[types[k]].RowWeight(row);
+        total += tot[k];
+      }
+      for (i64 c = 0; c < count; ++c) {
+        i64 o = i * count + c;
+        nbr[o] = kDefaultId;
+        mask[o] = 0;
+        nrow[o] = -1;
+        NeighborPick p =
+            PickNeighbor(s, row, types, ntypes, tot.data(), total, rng);
+        if (p.el < 0) continue;
+        nbr[o] = p.csr->dst[p.el];
+        mask[o] = 1;
+        nrow[o] = p.csr->dst_row[p.el];
+      }
+    }
+  });
+}
+
 void etpu_sample_neighbor_dir(void* h, const u64* ids, i64 n,
                               const i32* types, i64 ntypes, i64 count,
                               u8 in_edges, u64 seed, u64* nbr, f32* w,

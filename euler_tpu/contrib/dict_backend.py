@@ -64,6 +64,14 @@ class DictGraph:
         self._types = np.asarray(
             [self.nodes[int(i)]["type"] for i in self._ids], np.int64
         )
+        # feature schema: name → dim, from first occurrence (the columnar
+        # store gets this from GraphMeta; a dict store derives it) — so
+        # feature fetches are total functions of the schema, not of
+        # whichever ids happen to be in the queried batch
+        self._feat_dims: dict[str, int] = {}
+        for n in self.nodes.values():
+            for name, v in n["features"].items():
+                self._feat_dims.setdefault(name, len(v))
 
     # -- the query surface the model stack uses --------------------------
 
@@ -112,23 +120,16 @@ class DictGraph:
 
     def get_dense_feature(self, ids, names):
         ids = np.asarray(ids, dtype=np.uint64)
-        rows = []
-        dim = None
-        for nid in ids.tolist():
+        dims = [self._feat_dims.get(nm, 0) for nm in names]
+        out = np.zeros((len(ids), sum(dims)), np.float32)
+        for i, nid in enumerate(ids.tolist()):
             feats = self.nodes.get(int(nid), {}).get("features", {})
-            vec = []
-            for nm in names:
+            off = 0
+            for nm, d in zip(names, dims):
                 v = feats.get(nm)
-                if v is not None:
-                    vec.extend(float(x) for x in v)
-            rows.append(vec)
-            if vec and dim is None:
-                dim = len(vec)
-        dim = dim or 0
-        out = np.zeros((len(ids), dim), np.float32)
-        for i, vec in enumerate(rows):
-            if len(vec) == dim and dim:
-                out[i] = vec
+                if v is not None and len(v) == d:
+                    out[i, off : off + d] = v
+                off += d  # missing names stay zero, like the columnar store
         return out
 
     def node_type(self, ids):

@@ -99,6 +99,7 @@ class RemoteShard:
         self._rr = 0
         self._lock = threading.Lock()
         self._num_nodes: int | None = None
+        self._unit_w: dict[tuple, bool] = {}
 
     @property
     def part(self) -> int:
@@ -170,6 +171,22 @@ class RemoteShard:
             ],
         )
         return _bool_mask(out, 3)
+
+    def sample_neighbor_rows(self, ids, edge_types=None, count=10, rng=None):
+        nbr, mask, rows = self.call(
+            "sample_nb_rows",
+            [np.asarray(ids, np.uint64), _types(edge_types), int(count),
+             _seed(rng)],
+        )
+        return nbr, mask.astype(bool), rows
+
+    def unit_edge_weights(self, edge_types=None) -> bool:
+        key = tuple(_types(edge_types) or ())
+        if key not in self._unit_w:
+            self._unit_w[key] = bool(
+                self.call("unit_edge_weights", [_types(edge_types)])[0]
+            )
+        return self._unit_w[key]
 
     def get_full_neighbor(
         self, ids, edge_types=None, max_degree=None, in_edges=False, sort_by=None

@@ -347,6 +347,13 @@ class GraphService:
         if op == "sample_neighbor":
             out = s.sample_neighbor(a[0], a[1], a[2], _rng_from(a[3]), a[4])
             return list(out)
+        if op == "sample_nb_rows":
+            nbr, mask, rows = s.sample_neighbor_rows(
+                a[0], a[1], a[2], _rng_from(a[3])
+            )
+            return [nbr, mask.astype(np.uint8), rows]
+        if op == "unit_edge_weights":
+            return [bool(s.unit_edge_weights(a[0]))]
         if op == "get_full_neighbor":
             out = s.get_full_neighbor(a[0], a[1], a[2], a[3], a[4])
             return list(out)
@@ -428,29 +435,55 @@ class GraphService:
         root ids, one int32 feature-row array covering every hop, and the
         root labels — the minimum bytes a rows-mode trainer needs.
         """
-        from euler_tpu.graph.store import lean_wire_ok
+        from euler_tpu.graph.store import lean_feats, lean_wire_ok
 
         g = self._cluster()
         rng = _rng_from(seed)
         counts = [int(c) for c in counts]
         roots = g.sample_node(int(batch_size), int(node_type), rng)
+
+        def labels_of(hop0_rows):
+            return (
+                g.get_dense_by_rows(np.asarray(hop0_rows, np.int64), [label])
+                if label
+                else None
+            )
+
+        if lean and g.num_shards > 1:
+            # lean leaf protocol: per hop ship only ids+mask+rows between
+            # shards (no weights/types/edge-ids — 2/3 of the leaf bytes),
+            # with rows pre-resolved by each sampler's dst_row cache and
+            # one batched round for the rest. hop_w=None: unit weights
+            # were verified cluster-wide. Single-shard clusters stay on
+            # the one-call native fused fanout below (it beats per-hop
+            # Python rounds); peers predating the lean leaf ops drop to
+            # the generic path the same way.
+            try:
+                res = (
+                    g.fanout_rows_lean(roots, edge_types, counts, rng)
+                    if g.unit_edge_weights(edge_types)
+                    else None
+                )
+            except RuntimeError as e:
+                if "unknown op" not in str(e):
+                    raise
+                res = None
+            if res is not None:
+                _, hop_mask, hop_rows = res
+                if lean_wire_ok(roots, None, hop_mask, hop_rows):
+                    return [
+                        roots,
+                        lean_feats(hop_rows),
+                        labels_of(hop_rows[0]),
+                        True,
+                    ]
         res = g.fanout_with_rows(roots, edge_types, counts, rng)
         if res is None:
             raise RuntimeError("fused fanout unsupported on this cluster")
         hop_ids, hop_w, hop_tt, hop_mask, hop_rows = res
-        labels = (
-            g.get_dense_by_rows(np.asarray(hop_rows[0], np.int64), [label])
-            if label
-            else None
-        )
+        labels = labels_of(hop_rows[0])
         if lean and lean_wire_ok(roots, hop_w, hop_mask, hop_rows):
-            feats = np.concatenate(
-                [
-                    np.where(r >= 0, r + 1, 0).astype(np.int32)
-                    for r in hop_rows
-                ]
-            )
-            return [roots, feats, labels, True]
+            return [roots, lean_feats(hop_rows), labels, True]
         return [
             roots,
             np.concatenate(hop_ids),

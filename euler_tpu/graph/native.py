@@ -145,6 +145,10 @@ def _load_lib():
         c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_int64,
         c.c_uint8, c.c_uint64, u64p, f32p, i32p, u8p, i64p,
     ]
+    lib.etpu_sample_neighbor_rows.argtypes = [
+        c.c_void_p, u64p, c.c_int64, i32p, c.c_int64, c.c_int64,
+        c.c_uint64, u64p, u8p, i64p,
+    ]
     _lib = lib
     return lib
 
@@ -262,6 +266,31 @@ class NativeGraphStore(GraphStore):
             _i64p(eidx),
         )
         return nbr, w, tt, mask.astype(bool), eidx
+
+    def sample_neighbor_rows(self, ids, edge_types=None, count=10, rng=None):
+        """Lean leaf draw: (nbr, mask, local_rows) with rows pre-resolved
+        from the engine's load-time dst_row cache (-1 for off-shard dsts).
+        No weight/type/edge-id outputs — the distributed lean fanout never
+        needs them and they dominate the coordinator's byte-shuffling."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        n = len(ids)
+        types = _types_arr(edge_types)
+        nbr = np.empty((n, count), dtype=np.uint64)
+        mask = np.empty((n, count), dtype=np.uint8)
+        rows = np.empty((n, count), dtype=np.int64)
+        self._lib.etpu_sample_neighbor_rows(
+            ctypes.c_void_p(self._h),
+            _u64p(ids),
+            n,
+            _i32p(types),
+            len(types),
+            count,
+            ctypes.c_uint64(self._seed(rng)),
+            _u64p(nbr),
+            _u8p(mask),
+            _i64p(rows),
+        )
+        return nbr, mask.astype(bool), rows
 
     def degree_sum(self, ids, edge_types=None, in_edges=False):
         if in_edges and not self.inadj:

@@ -56,15 +56,16 @@ def split_hops(n_roots: int, counts, *arrays):
 
 def lean_wire_ok(roots, hop_w, hop_mask, hop_rows) -> bool:
     """True when a fused-fanout batch satisfies the LEAN-wire invariants:
-    unit edge weights, no valid root id truncating to int32 -1, and no
-    sampler-valid neighbor resolving to a dangling (-1) feature row.
-    Lean hydration (dataflow/base.py hydrate_blocks) rebuilds edge_w as
-    1.0 and derives validity from feature row > 0 / int32 root_idx — a
-    batch violating any invariant would silently train on wrong values,
-    so the ONE definition of the check is shared by the client flow and
-    the serving coordinator."""
+    unit edge weights (hop_w=None means weights were already proven unit
+    cluster-wide, e.g. via unit_edge_weights), no valid root id truncating
+    to int32 -1, and no sampler-valid neighbor resolving to a dangling
+    (-1) feature row. Lean hydration (dataflow/base.py hydrate_blocks)
+    rebuilds edge_w as 1.0 and derives validity from feature row > 0 /
+    int32 root_idx — a batch violating any invariant would silently train
+    on wrong values, so the ONE definition of the check is shared by the
+    client flow and the serving coordinator."""
     roots = np.asarray(roots, dtype=np.uint64)
-    unit_w = all(
+    unit_w = hop_w is None or all(
         np.all(w.reshape(-1)[m.reshape(-1)] == 1.0)
         for w, m in zip(hop_w[1:], hop_mask[1:])
     )
@@ -75,6 +76,20 @@ def lean_wire_ok(roots, hop_w, hop_mask, hop_rows) -> bool:
         for r, m in zip(hop_rows[1:], hop_mask[1:])
     )
     return unit_w and not alias and not dangling
+
+
+def lean_feats(hop_rows) -> np.ndarray:
+    """Concatenated int32 lean feature slots over all hops: global row+1,
+    0 for padding/missing — the exact encoding hydrate_blocks and
+    DeviceFeatureCache.gather expect."""
+    return np.concatenate(
+        [
+            np.where(np.asarray(r) >= 0, np.asarray(r) + 1, 0).astype(
+                np.int32
+            )
+            for r in hop_rows
+        ]
+    )
 
 
 def multi_hop_neighbor(graph, nodes, edge_types_per_hop):
@@ -281,6 +296,7 @@ class GraphStore:
         self._edge_key_index: tuple | None = None  # lexsorted (src,dst,type)
         self._index_mgr = None
         self._edge_index_mgr = None
+        self._unit_w: dict[int, bool] = {}  # per-type all-weights-==-1.0
 
     # ---- id resolution -------------------------------------------------
 
@@ -323,6 +339,43 @@ class GraphStore:
             )
             s = self._samplers_e[key] = _WeightedSampler(w)
         return s
+
+    def unit_edge_weights(self, edge_types=None) -> bool:
+        """True when every (selected) out-edge weight is exactly 1.0 —
+        the precondition for the distributed LEAN fanout to skip shipping
+        weights entirely. Chunked scan with early exit (weighted graphs
+        usually fail within the first chunk; uniform graphs stream the
+        mmap once without a whole-array boolean temp), cached per type."""
+        types = (
+            range(self.meta.num_edge_types)
+            if edge_types is None
+            else edge_types
+        )
+        for t in types:
+            key = int(t)
+            if key not in self._unit_w:
+                ok = True
+                if key < len(self.adj):
+                    w = self.adj[key].w
+                    for lo in range(0, len(w), 1 << 22):
+                        if not np.all(w[lo : lo + (1 << 22)] == 1.0):
+                            ok = False
+                            break
+                self._unit_w[key] = ok
+            if not self._unit_w[key]:
+                return False
+        return True
+
+    def sample_neighbor_rows(self, ids, edge_types=None, count=10, rng=None):
+        """Lean neighbor draw: (nbr, mask, local_rows) — rows are this
+        shard's local node rows of each picked dst, -1 when the dst is
+        owned elsewhere. Pure-numpy twin of the engine's
+        etpu_sample_neighbor_rows."""
+        nbr, _, _, mask, _ = self.sample_neighbor(
+            ids, edge_types, count, rng
+        )
+        rows = self.lookup(nbr.reshape(-1)).reshape(nbr.shape)
+        return nbr, mask, rows
 
     def sample_node(self, count: int, node_type: int = -1, rng=None) -> np.ndarray:
         sampler = self._node_sampler(node_type)
@@ -1212,6 +1265,67 @@ class Graph:
             all_rows[offs[i] : offs[i + 1]] for i in range(len(hop_ids))
         ]
         return hop_ids, hop_w, hop_tt, hop_mask, hop_rows
+
+    def unit_edge_weights(self, edge_types=None) -> bool:
+        return all(
+            hasattr(s, "unit_edge_weights") and s.unit_edge_weights(edge_types)
+            for s in self.shards
+        )
+
+    def fanout_rows_lean(self, ids, edge_types, counts, rng=None):
+        """Multi-shard fused fanout shipping ONLY ids+mask+rows per hop —
+        the distributed lean hot path. Per hop, the owner-scattered leaf
+        draw returns each pick's row when the dst happens to live on the
+        sampling shard (the engine's dst_row cache makes that free); one
+        final batched lookup round resolves the rest, roots included.
+        Returns (hop_ids, hop_mask, hop_rows[global]) or None when a
+        shard lacks the lean leaf surface.
+        """
+        if not all(hasattr(s, "sample_neighbor_rows") for s in self.shards):
+            return None
+        try:
+            return self._fanout_rows_lean(ids, edge_types, counts, rng)
+        except RuntimeError as e:
+            if "unknown op" in str(e):
+                # remote shards always expose the client method; a server
+                # predating the lean leaf ops surfaces here instead
+                return None
+            raise
+
+    def _fanout_rows_lean(self, ids, edge_types, counts, rng=None):
+        rng = _rng(rng)
+        offsets = self._shard_row_offsets()
+        ids = np.asarray(ids, dtype=np.uint64)
+        hop_ids = [ids]
+        hop_mask = [ids != DEFAULT_ID]
+        hop_rows = [np.full(len(ids), -1, dtype=np.int64)]
+        cur = ids
+        for c in counts:
+            def fn(shard, sub, c=int(c)):
+                nbr, mask, rows = shard.sample_neighbor_rows(
+                    sub, edge_types, c, rng
+                )
+                rows = np.asarray(rows, np.int64)
+                rows = np.where(rows >= 0, rows + offsets[shard.part], -1)
+                return nbr, mask.astype(bool), rows
+            nbr, mask, rows = self._scatter_gather(cur, fn)
+            cur = nbr.reshape(-1)
+            hop_ids.append(cur)
+            hop_mask.append(mask.reshape(-1))
+            hop_rows.append(rows.reshape(-1))
+        # one batched resolve for every still-unknown row (roots + the
+        # picks whose dst lives off its sampling shard)
+        all_rows = np.concatenate(hop_rows)
+        all_mask = np.concatenate(hop_mask)
+        need = (all_rows < 0) & all_mask
+        if need.any():
+            all_ids = np.concatenate(hop_ids)
+            all_rows[need] = self.lookup_rows(all_ids[need])
+        offs = np.r_[0, np.cumsum([len(h) for h in hop_ids])]
+        hop_rows = [
+            all_rows[offs[i] : offs[i + 1]] for i in range(len(hop_ids))
+        ]
+        return hop_ids, hop_mask, hop_rows
 
     def sage_minibatch(
         self,

@@ -167,6 +167,45 @@ def test_sage_minibatch_one_rpc(unit_cluster):
     assert h.blocks[0].edge_src.shape == (15,)
 
 
+def test_lean_leaf_ops_over_wire(unit_cluster):
+    """The lean leaf protocol surface: unit_edge_weights and
+    sample_nb_rows (ids+mask+local-rows only) over the socket."""
+    remote, local = unit_cluster
+    assert remote.unit_edge_weights()
+    shard = remote.shards[0]
+    ids = np.arange(1, 7, dtype=np.uint64)
+    nbr, mask, rows = shard.sample_neighbor_rows(
+        ids, None, 4, rng=np.random.default_rng(0)
+    )
+    assert nbr.shape == (6, 4) and mask.dtype == bool
+    # resolved rows point at the serving shard's own node table
+    ok = rows >= 0
+    if ok.any():
+        local_shard = local.shards[0]
+        back = np.asarray(local_shard.node_ids)[rows[ok]]
+        np.testing.assert_array_equal(back, nbr[ok])
+    # facade-level lean fanout over remote shards agrees with local
+    hop_ids, hop_mask, hop_rows = remote.fanout_rows_lean(
+        ids, None, [3, 2], rng=np.random.default_rng(1)
+    )
+    assert [len(r) for r in hop_rows] == [6, 18, 36]
+    offs = np.cumsum([0] + [s.num_nodes for s in local.shards])
+    allids = np.concatenate(
+        [np.asarray(s.node_ids) for s in local.shards]
+    )
+    for h in range(3):
+        m = hop_mask[h]
+        assert (hop_rows[h][m] >= 0).all()
+        np.testing.assert_array_equal(
+            allids[hop_rows[h][m]], hop_ids[h][m]
+        )
+
+
+def test_weighted_graph_refuses_unit_weights(cluster):
+    remote, *_ = cluster
+    assert not remote.unit_edge_weights()
+
+
 def test_sage_minibatch_downgrade_on_weighted_graph(
     tmp_path_factory, fixture_graph_dict
 ):
