@@ -99,7 +99,7 @@ class RemoteShard:
         self._rr = 0
         self._lock = threading.Lock()
         self._num_nodes: int | None = None
-        self._unit_w: dict[tuple, bool] = {}
+        self._unit_w: dict[tuple | None, bool] = {}
 
     @property
     def part(self) -> int:
@@ -181,7 +181,9 @@ class RemoteShard:
         return nbr, mask.astype(bool), rows
 
     def unit_edge_weights(self, edge_types=None) -> bool:
-        key = tuple(_types(edge_types) or ())
+        # None (all types) and [] (no types) answer differently — keep
+        # their cache entries distinct
+        key = None if edge_types is None else tuple(_types(edge_types))
         if key not in self._unit_w:
             self._unit_w[key] = bool(
                 self.call("unit_edge_weights", [_types(edge_types)])[0]
@@ -387,6 +389,29 @@ class RemoteShard:
             "get_edge_dense_feature",
             [np.asarray(edge_ids, np.uint64), list(names)],
         )[0]
+
+    def get_edge_sparse_feature(self, edge_ids, names, max_len=None):
+        flat = self.call(
+            "get_edge_sparse_feature",
+            [np.asarray(edge_ids, np.uint64), list(names), max_len],
+        )
+        return [
+            (flat[2 * i], flat[2 * i + 1].astype(bool))
+            for i in range(len(names))
+        ]
+
+    def get_edge_binary_feature(self, edge_ids, names):
+        flat = self.call(
+            "get_edge_binary_feature",
+            [np.asarray(edge_ids, np.uint64), list(names)],
+        )
+        out = []
+        for i in range(len(names)):
+            offs, blob = flat[2 * i], flat[2 * i + 1].tobytes()
+            out.append(
+                [blob[offs[j] : offs[j + 1]] for j in range(len(offs) - 1)]
+            )
+        return out
 
     def get_graph_by_label(self, label_ids):
         return self.call(

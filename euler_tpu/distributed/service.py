@@ -384,6 +384,18 @@ class GraphService:
             return result
         if op == "get_edge_dense_feature":
             return [s.get_edge_dense_feature(a[0], a[1])]
+        if op == "get_edge_sparse_feature":
+            pairs = s.get_edge_sparse_feature(a[0], a[1], a[2])
+            return [x for pair in pairs for x in pair]
+        if op == "get_edge_binary_feature":
+            outs = s.get_edge_binary_feature(a[0], a[1])
+            result = []
+            for vals in outs:
+                blob = b"".join(vals)
+                offs = np.cumsum([0] + [len(v) for v in vals]).astype(np.int64)
+                result.append(offs)
+                result.append(np.frombuffer(blob, dtype=np.uint8))
+            return result
         if op == "get_graph_by_label":
             return [list(s.get_graph_by_label(a[0]))]
         if op == "condition_weight":
