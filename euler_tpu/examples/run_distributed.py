@@ -25,7 +25,16 @@ def main(argv=None):
     ap.add_argument("--shards", type=int, default=2)
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) before device init")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        # env JAX_PLATFORMS is overridden by site-level platform pinning,
+        # so an in-process config update is the reliable switch
+        jax.config.update("jax_platforms", args.platform)
 
     import numpy as np
 
@@ -63,23 +72,23 @@ def main(argv=None):
             DeviceFeatureCache,
             Estimator,
             EstimatorConfig,
-            node_batches,
         )
         from euler_tpu.models import GraphSAGESupervised
 
         rng = np.random.default_rng(0)
-        # full hot path against the cluster: each batch is ONE fused-fanout
-        # RPC returning ids + shard-major rows; features stay device-side in
-        # the cache and the wire ships int32 rows only
+        # full hot path against the cluster: each batch is ONE RPC — the
+        # serving shard samples roots, coordinates the multi-hop fanout
+        # next to the data, and returns the LEAN wire (int32 feature-cache
+        # rows + labels only); features stay device-side in the cache
         cache = DeviceFeatureCache(remote, ["feat"])
         flow = SageDataFlow(
             remote, ["feat"], fanouts=[5, 5], label_feature="label", rng=rng,
-            feature_mode="rows",
+            feature_mode="rows", lean=True,
         )
         model = GraphSAGESupervised(dims=[32, 32], label_dim=2)
         est = Estimator(
             model,
-            node_batches(remote, flow, args.batch_size, rng=rng),
+            lambda: (flow.minibatch(args.batch_size),),
             EstimatorConfig(
                 model_dir=os.path.join(work, "model"),
                 total_steps=args.steps,
