@@ -351,7 +351,9 @@ class GraphService:
             nbr, mask, rows = s.sample_neighbor_rows(
                 a[0], a[1], a[2], _rng_from(a[3])
             )
-            return [nbr, mask.astype(np.uint8), rows]
+            # local rows always fit int32 (engine caps shards at 2^31
+            # nodes) — half the bytes of the biggest lean-leaf column
+            return [nbr, mask.astype(np.uint8), rows.astype(np.int32)]
         if op == "unit_edge_weights":
             return [bool(s.unit_edge_weights(a[0]))]
         if op == "get_full_neighbor":
