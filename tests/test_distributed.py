@@ -433,6 +433,33 @@ def test_sigkill_failover_mid_training(tmp_path, fixture_graph_dict):
             p.wait(timeout=10)
 
 
+def test_malformed_frame_costs_connection_not_server(cluster):
+    """Garbage bytes on the wire must close THAT connection only; the
+    worker pool keeps serving other clients (service.py _worker: 'a
+    malformed frame must cost the CONNECTION, not the worker')."""
+    import socket as socket_mod
+    import struct
+
+    remote, _, services, *_ = cluster
+    port = services[0].port
+    # a frame whose payload is garbage (bad op-length prefix)
+    s = socket_mod.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(struct.pack("<I", 8) + b"\xff" * 8)
+    s.settimeout(10)
+    assert s.recv(1) == b""  # server closed our connection
+    s.close()
+    # and an oversized frame header is rejected the same way
+    s2 = socket_mod.create_connection(("127.0.0.1", port), timeout=10)
+    s2.sendall(struct.pack("<I", 0xFFFFFFFF))
+    s2.settimeout(10)
+    assert s2.recv(1) == b""
+    s2.close()
+    # the server still answers well-formed requests afterwards
+    assert remote.shards[0].node_type(
+        np.asarray([2], np.uint64)
+    ).tolist() == [0]
+
+
 def test_server_error_reporting(cluster):
     remote, *_ = cluster
     with pytest.raises(RpcError, match="unknown"):
