@@ -77,10 +77,41 @@ def _full_graph_f1(g, tr_ids, te_ids, conv, dims, tmp_path, steps=200,
     return est.evaluate([(flow.query(te_ids),)])["f1"]
 
 
-def _splits(types):
-    tr = (np.nonzero(types == 0)[0] + 1).astype(np.uint64)
+def _splits(types, train_pool=(0,)):
+    """(train_ids, test_ids) as 1-based uint64; train_pool selects which
+    node types feed training (the published 140-label split is type 0;
+    (0, 1) is the documented 640-label pool for memorization-prone
+    convs)."""
+    tr = (
+        np.nonzero(np.isin(types, list(train_pool)))[0] + 1
+    ).astype(np.uint64)
     te = (np.nonzero(types == 2)[0] + 1).astype(np.uint64)
     return tr, te
+
+
+def _edge_mrr(g, model, params, num_negs=20):
+    """Held-out edge-ranking MRR shared by the skip-gram probes: score
+    each sampled edge's dst against num_negs sampled negatives."""
+    import jax.numpy as jnp
+
+    rng_e = np.random.default_rng(123)
+    e = g.sample_edge(2000, rng=rng_e)
+    src = e[:, 0].astype(np.int64).astype(np.int32)
+    pos = e[:, 1].astype(np.int64).astype(np.int32)
+    negs = (
+        g.sample_node(2000 * num_negs, rng=rng_e)
+        .astype(np.int64).astype(np.int32).reshape(2000, num_negs)
+    )
+    emb = model.apply(params, jnp.asarray(src), method=model.embed)
+    ctx = lambda ids: model.apply(params, jnp.asarray(ids), method=model._ctx)
+    pos_s = jnp.sum(emb * ctx(pos), axis=1)
+    neg_s = jnp.einsum(
+        "bd,bnd->bn",
+        emb,
+        ctx(negs.reshape(-1)).reshape(2000, num_negs, -1),
+    )
+    ranks = 1 + jnp.sum((neg_s > pos_s[:, None]).astype(jnp.int32), axis=1)
+    return float(jnp.mean(1.0 / ranks))
 
 
 def test_gcn_cora_f1(cora_like, tmp_path):
@@ -165,18 +196,78 @@ def test_graphsage_cora_f1(cora_like, tmp_path):
     assert 0.84 < f1 < 0.96, f"GraphSAGE f1 {f1:.3f} out of calibrated band"
 
 
+@pytest.mark.parametrize(
+    "conv,published,lo,hi",
+    [
+        # measured on seed 0 — full-graph, 140-label published protocol
+        ("agnn", 0.813, 0.72, 0.86),   # measured 0.777
+        ("arma", 0.822, 0.65, 0.82),   # measured 0.714 — iterative ARMA
+        # stacks pay the stand-in's noise penalty like GAT does
+        ("sgcn", 0.825, 0.79, 0.92),   # measured 0.856
+        ("tagcn", 0.817, 0.70, 0.86),  # measured 0.765
+    ],
+)
+def test_conv_family_cora_f1(cora_like, tmp_path, conv, published, lo, hi):
+    """Per-family calibrated bands against the published cora scores
+    (examples/<name>/README.md result tables, BASELINE.md)."""
+    g, _, _, types = cora_like
+    tr_ids, te_ids = _splits(types)
+    f1 = _full_graph_f1(g, tr_ids, te_ids, conv, [16, 16], tmp_path)
+    assert lo < f1 < hi, (
+        f"{conv} f1 {f1:.3f} out of calibrated band (published {published})"
+    )
+
+
+@pytest.mark.parametrize(
+    "conv,published,lo,hi",
+    [
+        # DNA's layer-attention and GeniePath's depth-LSTM memorize the
+        # stand-in's near-unique features at 140 labels (like SAGE, see
+        # test_graphsage_cora_f1); the 640-label pool is the fair probe
+        ("dna", 0.811, 0.75, 0.90),        # measured 0.824
+        ("geniepath", 0.742, 0.70, 0.88),  # measured 0.796 after the
+        # depth-recurrence fix (LSTM carry from the previous layer)
+    ],
+)
+def test_conv_family_cora_f1_640(cora_like, tmp_path, conv, published, lo, hi):
+    g, _, _, types = cora_like
+    tr_ids, te_ids = _splits(types, train_pool=(0, 1))
+    f1 = _full_graph_f1(
+        g, tr_ids, te_ids, conv, [32, 32], tmp_path, steps=300, lr=0.02
+    )
+    assert lo < f1 < hi, (
+        f"{conv} f1 {f1:.3f} out of calibrated band (published {published})"
+    )
+
+
+def test_line_mrr(cora_like, tmp_path):
+    """LINE published cora MRR 0.900 (examples/line/README.md); the
+    first-order shared-context variant the `line` example runs measures
+    0.9261 on the stand-in (2000 steps, 20 negatives)."""
+    from euler_tpu.models import SkipGramModel, line_batches
+
+    g, *_ = cora_like
+    rng = np.random.default_rng(0)
+    model = SkipGramModel(num_nodes=2709, dim=32, shared_context=True)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "line"), learning_rate=0.05,
+        log_steps=10**9,
+    )
+    est = Estimator(model, line_batches(g, 128, num_negs=20, rng=rng), cfg)
+    est.train(total_steps=2000, save=False, log=False)
+    mrr = _edge_mrr(g, model, est.params)
+    assert 0.87 < mrr < 0.97, f"LINE mrr {mrr:.3f} out of band"
+
+
 def test_deepwalk_mrr(cora_like, tmp_path):
     """DeepWalk published cora MRR 0.905 (examples/deepwalk/README.md,
     walk_len 3, window 1, 20 negatives). Measured 0.943 on the stand-in
     (denser than cora, so ranking positives is slightly easier)."""
-    import jax.numpy as jnp
-
     from euler_tpu.models import SkipGramModel, deepwalk_batches
 
     g, *_ = cora_like
     rng = np.random.default_rng(0)
-    n = 2708
-    model = SkipGramModel(num_nodes=n + 1, dim=32)
+    model = SkipGramModel(num_nodes=2709, dim=32)
     cfg = EstimatorConfig(
         model_dir=str(tmp_path / "dw"), learning_rate=0.05, log_steps=10**9
     )
@@ -188,24 +279,7 @@ def test_deepwalk_mrr(cora_like, tmp_path):
         cfg,
     )
     est.train(total_steps=600, save=False, log=False)
-    rng_e = np.random.default_rng(123)
-    e = g.sample_edge(2000, rng=rng_e)
-    src = e[:, 0].astype(np.int64).astype(np.int32)
-    pos = e[:, 1].astype(np.int64).astype(np.int32)
-    negs = (
-        g.sample_node(2000 * 20, rng=rng_e)
-        .astype(np.int64).astype(np.int32).reshape(2000, 20)
-    )
-    emb = model.apply(est.params, jnp.asarray(src), method=model.embed)
-    ctx = lambda ids: model.apply(
-        est.params, jnp.asarray(ids), method=model._ctx
-    )
-    pos_s = jnp.sum(emb * ctx(pos), axis=1)
-    neg_s = jnp.einsum(
-        "bd,bnd->bn", emb, ctx(negs.reshape(-1)).reshape(2000, 20, -1)
-    )
-    ranks = 1 + jnp.sum((neg_s > pos_s[:, None]).astype(jnp.int32), axis=1)
-    mrr = float(jnp.mean(1.0 / ranks))
+    mrr = _edge_mrr(g, model, est.params)
     assert 0.87 < mrr < 0.995, f"DeepWalk mrr {mrr:.3f} out of band"
 
 
