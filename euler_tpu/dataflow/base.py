@@ -119,6 +119,7 @@ def fanout_block(
     lazy: bool = False,
     ship_w: bool = True,
     ship_mask: bool = True,
+    w_dtype=np.float32,
 ) -> Block:
     """Block for sampled fanout: src j feeds dst j // fanout.
 
@@ -131,6 +132,9 @@ def fanout_block(
     Only valid for rows-mode batches whose consumer is weight-agnostic
     (mask-normalized mean/attention aggregators) or whose graph weights
     are all 1.0 — a uniform weight c != 1 would be rebuilt as 1.
+    w_dtype picks the wire dtype for shipped weights; the weighted-lean
+    path ships bfloat16 (half the bytes, graph weights need no more
+    precision) and hydrate_blocks upcasts on device.
     """
     e = batch * fanout
     return Block(
@@ -138,7 +142,7 @@ def fanout_block(
         edge_dst=None if lazy else np.repeat(
             np.arange(batch, dtype=np.int32), fanout
         ),
-        edge_w=w.reshape(-1).astype(np.float32) if ship_w else None,
+        edge_w=w.reshape(-1).astype(w_dtype) if ship_w else None,
         mask=mask.reshape(-1) if ship_mask else None,
         n_src=e,
         n_dst=batch,
@@ -168,6 +172,10 @@ def upgrade_lean_host(batch: MiniBatch) -> MiniBatch:
             b = b.replace(mask=masks[h + 1].reshape(-1))
         if b.edge_w is None:
             b = b.replace(edge_w=np.asarray(b.mask, np.float32))
+        elif np.asarray(b.edge_w).dtype != np.float32:
+            b = b.replace(  # weighted-lean wire ships bf16
+                edge_w=np.asarray(b.edge_w, np.float32)
+            )
         blocks.append(b)
     return batch.replace(masks=masks, blocks=tuple(blocks))
 
@@ -202,6 +210,10 @@ def hydrate_blocks(batch: MiniBatch) -> MiniBatch:
             b = b.replace(mask=masks[h + 1].reshape(-1))
         if b.edge_w is None:
             b = b.replace(edge_w=b.mask.astype(jnp.float32))
+        elif jnp.asarray(b.edge_w).dtype != jnp.float32:
+            b = b.replace(  # weighted-lean wire ships bf16; upcast on device
+                edge_w=jnp.asarray(b.edge_w).astype(jnp.float32)
+            )
         if b.edge_src is None:
             b = b.replace(
                 edge_src=jnp.arange(b.n_src, dtype=jnp.int32),

@@ -6,8 +6,12 @@ from __future__ import annotations
 
 import numpy as np
 
+import ml_dtypes
+
 from euler_tpu.dataflow.base import DataFlow, MiniBatch, fanout_block
 from euler_tpu.graph.store import DEFAULT_ID, lean_wire_ok
+
+_BF16 = np.dtype(ml_dtypes.bfloat16)
 
 
 class SageDataFlow(DataFlow):
@@ -27,7 +31,15 @@ class SageDataFlow(DataFlow):
         """lean=True minimizes wire bytes on the fused rows path: ships only
         int32 feature rows + labels, with edge ids, masks, and (uniform)
         weights rebuilt on device by hydrate_blocks. Requires
-        feature_mode="rows"; hop_ids are omitted (no id-embedding models)."""
+        feature_mode="rows"; hop_ids are omitted (no id-embedding models).
+
+        Weighted graphs stay lean too (VERDICT r3 #5): when the graph's
+        edge weights are not all 1.0, the flow ships bf16 weights next to
+        the int32 rows (~1.5x lean bytes) instead of downgrading to the
+        ~6x full wire the way the reference's REMOTE op never has to
+        (remote_op.cc:60-120 serves weighted graphs at full speed). The
+        mode is decided once at construction so every batch of a run has
+        the same pytree structure."""
         if lean and feature_mode != "rows":
             raise ValueError("lean=True requires feature_mode='rows'")
         super().__init__(
@@ -41,6 +53,15 @@ class SageDataFlow(DataFlow):
         # then on every batch ships full arrays so pytree structure stays
         # stable across a run (stack_batches / scan-dispatch requirement)
         self._lean_off = False
+        # weighted-lean: ship bf16 edge weights when the graph is weighted
+        self._lean_w = False
+        if lean:
+            probe = getattr(graph, "unit_edge_weights", None)
+            try:
+                self._lean_w = probe is not None and not probe(edge_types)
+            except Exception:
+                self._lean_w = False  # can't tell → unit-lean with its
+                # per-batch lean_wire_ok guard (weighted batches downgrade)
 
     @property
     def num_hops(self) -> int:
@@ -79,13 +100,27 @@ class SageDataFlow(DataFlow):
                 res["feats"][offs[i] : offs[i + 1]]
                 for i in range(len(widths))
             )
+            # weighted-lean: the server shipped bf16 weights, concat over
+            # hops 1.. (same widths as the non-root feats)
+            w = res.get("w")
+            w_hops = (
+                None
+                if w is None
+                else [
+                    w[offs[i] - offs[1] : offs[i + 1] - offs[1]]
+                    for i in range(1, len(widths))
+                ]
+            )
             blocks = []
             width = len(roots)
-            for k in self.fanouts:
+            for h, k in enumerate(self.fanouts):
                 blocks.append(
                     fanout_block(
-                        width, k, None, None,
-                        lazy=True, ship_w=False, ship_mask=False,
+                        width, k,
+                        None if w_hops is None else w_hops[h], None,
+                        lazy=True, ship_w=w_hops is not None,
+                        ship_mask=False,
+                        w_dtype=None if w_hops is None else w_hops[h].dtype,
                     )
                 )
                 width *= k
@@ -171,9 +206,15 @@ class SageDataFlow(DataFlow):
             # it ships full arrays instead. The downgrade is STICKY: mixed
             # lean/full batches have different pytree structure, which
             # breaks steps_per_call stacking and forces jit recompiles.
-            lean = lean_wire_ok(roots, hop_w, hop_masks, hop_rows)
+            # Weighted graphs (self._lean_w) skip the unit-weight check
+            # and ship bf16 weights instead (weighted-lean wire).
+            lean = lean_wire_ok(
+                roots, hop_w, hop_masks, hop_rows,
+                require_unit_w=not self._lean_w,
+            )
             if not lean:
                 self._lean_off = True
+        lean_w = lean and self._lean_w
         blocks = []
         width = len(roots)
         for k, w, mask in zip(self.fanouts, hop_w[1:], hop_masks[1:]):
@@ -181,8 +222,9 @@ class SageDataFlow(DataFlow):
                 fanout_block(
                     width, k, w, mask,
                     lazy=self.lazy_blocks,
-                    ship_w=not lean,
+                    ship_w=(not lean) or lean_w,
                     ship_mask=not lean,
+                    w_dtype=_BF16 if lean_w else np.float32,
                 )
             )
             width *= k

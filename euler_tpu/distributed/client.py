@@ -327,6 +327,14 @@ class RemoteShard:
             ],
         )
         if out[-1]:
+            if len(out) == 5:  # weighted-lean: bf16 weights ride along
+                return {
+                    "lean": True,
+                    "roots": out[0],
+                    "feats": out[1],
+                    "w": out[2],
+                    "labels": out[3],
+                }
             return {
                 "lean": True,
                 "roots": out[0],
@@ -361,6 +369,16 @@ class RemoteShard:
         return self.call(
             "get_dense_by_rows", [np.asarray(rows, np.int64), list(names)]
         )[0]
+
+    def get_dense_feature_udf(self, ids, names, udfs):
+        """Server-side UDF aggregation (udf.h API_GET_P semantics): the
+        owning shard runs the UDF and the wire carries only the
+        aggregate columns, not the feature block."""
+        out = self.call(
+            "dense_feature_udf",
+            [np.asarray(ids, np.uint64), list(names), list(udfs)],
+        )
+        return out[0], out[1]
 
     def get_sparse_feature(self, ids, names, max_len=None):
         flat = self.call(

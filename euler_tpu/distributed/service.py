@@ -356,6 +356,15 @@ class GraphService:
             return [nbr, mask.astype(np.uint8), rows.astype(np.int32)]
         if op == "unit_edge_weights":
             return [bool(s.unit_edge_weights(a[0]))]
+        if op == "dense_feature_udf":
+            # server-side UDF aggregation: runs UDFs registered in THIS
+            # process (register_udf), like the reference's server-side
+            # kernel registry; unknown names raise back to the client,
+            # which falls back to client-side aggregation
+            from euler_tpu.query.gql import dense_feature_udf
+
+            out, w = dense_feature_udf(s, a[0], a[1], a[2])
+            return [out, w]
         if op == "get_full_neighbor":
             out = s.get_full_neighbor(a[0], a[1], a[2], a[3], a[4])
             return list(out)
@@ -496,8 +505,25 @@ class GraphService:
             raise RuntimeError("fused fanout unsupported on this cluster")
         hop_ids, hop_w, hop_tt, hop_mask, hop_rows = res
         labels = labels_of(hop_rows[0])
-        if lean and lean_wire_ok(roots, hop_w, hop_mask, hop_rows):
+        # lean flavor is a GRAPH-level property (unit weights or not), not
+        # per-batch: a coincidentally all-unit batch of a weighted graph
+        # must still ship weighted-lean so the client's pytree structure
+        # stays stable across the run
+        unit = g.unit_edge_weights(edge_types)
+        if lean and unit and lean_wire_ok(roots, hop_w, hop_mask, hop_rows):
             return [roots, lean_feats(hop_rows), labels, True]
+        if lean and not unit and lean_wire_ok(
+            roots, hop_w, hop_mask, hop_rows, require_unit_w=False
+        ):
+            # weighted-lean (VERDICT r3 #5): int32 rows + bf16 edge
+            # weights (hops 1..); ids/masks still rebuilt device-side —
+            # ~1.5x lean bytes instead of the ~6x full-wire downgrade
+            import ml_dtypes
+
+            w16 = np.concatenate(
+                [np.asarray(w).reshape(-1) for w in hop_w[1:]]
+            ).astype(ml_dtypes.bfloat16)
+            return [roots, lean_feats(hop_rows), w16, labels, True]
         return [
             roots,
             np.concatenate(hop_ids),

@@ -36,6 +36,13 @@ _DTYPE_CODES = {
     np.dtype(np.float64): 6,
     np.dtype(np.uint32): 7,
 }
+try:  # bfloat16 rides the wire for weighted lean minibatches; the C++
+    # engine never stores it, so the code is wire-only
+    import ml_dtypes
+
+    _DTYPE_CODES[np.dtype(ml_dtypes.bfloat16)] = 8
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    pass
 _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 
 

@@ -255,14 +255,21 @@ def test_weighted_graph_refuses_unit_weights(cluster):
     assert not remote.unit_edge_weights()
 
 
-def test_sage_minibatch_downgrade_on_weighted_graph(
+def test_sage_minibatch_weighted_lean_wire(
     tmp_path_factory, fixture_graph_dict
 ):
-    """A graph with non-unit edge weights must make the server refuse the
-    lean wire; the client builds the full batch and sticks to it."""
+    """A weighted graph stays LEAN (VERDICT r3 #5): the server ships bf16
+    edge weights next to the int32 rows instead of downgrading to the full
+    wire (the reference's REMOTE op serves weighted graphs at full speed,
+    remote_op.cc:60-120). Asserts: lean stays on, masks rebuilt on device,
+    weights correct, and wire bytes within ~1.6x of the unit-lean batch."""
     import copy
 
+    import ml_dtypes
+
     from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.dataflow.base import hydrate_blocks
+    from euler_tpu.distributed import wire
 
     g = copy.deepcopy(fixture_graph_dict)
     for e in g["edges"]:
@@ -281,15 +288,65 @@ def test_sage_minibatch_downgrade_on_weighted_graph(
             remote, ["dense2"], fanouts=[3], label_feature="dense3",
             rng=np.random.default_rng(0), feature_mode="rows", lean=True,
         )
+        assert flow._lean_w  # weighted graph → weighted-lean mode
         mb = flow.minibatch(4)
-        assert flow._lean_off  # sticky downgrade
-        assert mb.masks is not None
-        assert mb.blocks[0].edge_w is not None
-        w = mb.blocks[0].edge_w[mb.blocks[0].mask]
-        assert (w == 2.5).all()
-        # next batch keeps the downgraded structure (stacking-safe)
+        assert not flow._lean_off  # no downgrade
+        assert mb.masks is None  # masks still rebuilt on device
+        b = mb.blocks[0]
+        assert b.edge_w is not None and b.edge_w.dtype == ml_dtypes.bfloat16
+        assert b.mask is None and b.edge_src is None  # still lazy/lean
+        hyd = hydrate_blocks(mb)
+        hb = hyd.blocks[0]
+        assert hb.edge_w.dtype == np.float32
+        w = np.asarray(hb.edge_w)[np.asarray(hb.mask)]
+        assert (w == 2.5).all()  # 2.5 is bf16-exact
+        # next batch keeps the same (weighted-lean) structure
         mb2 = flow.minibatch(4)
-        assert mb2.masks is not None
+        assert mb2.masks is None and mb2.blocks[0].edge_w is not None
+
+        # wire-bytes bound: weighted-lean response within ~1.6x of the
+        # unit-lean response for the same batch geometry
+        def resp_bytes(payload):
+            buf = bytearray()
+            for v in payload:
+                wire._pack_value(buf, v)
+            return len(buf)
+
+        lean_w_resp = services[0]._sage_minibatch(
+            4, None, [3], "dense3", -1, 0, True
+        )
+        assert len(lean_w_resp) == 5  # roots, feats, w16, labels, True
+        # same server asked for the unit-lean shape of the same batch:
+        # drop the weights column
+        unit_equiv = [lean_w_resp[0], lean_w_resp[1], lean_w_resp[3],
+                      lean_w_resp[4]]
+        assert resp_bytes(lean_w_resp) < 1.6 * resp_bytes(unit_equiv)
+
+        # weighted-lean trains to the SAME loss trajectory as the full
+        # wire (same seeds → same sampled stream; 2.5 is bf16-exact)
+        from euler_tpu.estimator import Estimator, EstimatorConfig
+        from euler_tpu.nn import SuperviseModel
+
+        def run(lean):
+            flow = SageDataFlow(
+                remote, ["dense2"], fanouts=[3], label_feature="dense3",
+                rng=np.random.default_rng(42), feature_mode="rows",
+                lean=lean,
+            )
+            cfg = EstimatorConfig(
+                model_dir=str(d / f"train_{lean}"), total_steps=4,
+                log_steps=10**9,
+            )
+            from euler_tpu.estimator import DeviceFeatureCache
+
+            cache = DeviceFeatureCache(remote, ["dense2"])
+            est = Estimator(
+                model := SuperviseModel(conv="gcn", dims=[8], label_dim=3),
+                lambda: (flow.minibatch(4),), cfg, feature_cache=cache,
+            )
+            return est.train(save=False)
+
+        np.testing.assert_allclose(run(True), run(False), rtol=2e-5)
     finally:
         for s in services:
             s.stop()
@@ -604,6 +661,95 @@ def test_remote_condition_ops(cluster, rng):
     ednf = [[("e_dense", "gt", 4.0)]]
     edges = remote.sample_edge_with_condition(32, ednf, rng=rng)
     assert local.condition_mask(edges, ednf, node=False).all()
+
+
+def test_remote_gql_udf_server_side(tmp_path, rng):
+    """Remote `values(udf_*)` aggregates on the owning shard (udf.h /
+    API_GET_P semantics, VERDICT r3 #9): the wire response carries only
+    the aggregate columns — asserted ≪ the full feature block — and the
+    GQL result matches client-side aggregation exactly."""
+    from euler_tpu.query import run_gql
+
+    dim = 256
+    n = 40
+    rng_ = np.random.default_rng(5)
+    feats = rng_.normal(size=(n, dim)).astype(np.float32)
+    nodes = [
+        {
+            "id": i + 1, "type": 0, "weight": 1.0,
+            "features": [
+                {"name": "wide", "type": "dense",
+                 "value": feats[i].tolist()},
+            ],
+        }
+        for i in range(n)
+    ]
+    edges = [
+        {"src": i + 1, "dst": (i + 1) % n + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for i in range(n)
+    ]
+    data = str(tmp_path / "wide")
+    convert_json({"nodes": nodes, "edges": edges}, data, num_partitions=1)
+    srv = serve_shard(data, 0, native=False)
+    try:
+        remote = connect(cluster={0: [("127.0.0.1", srv.port)]})
+        ids = np.arange(1, n + 1, dtype=np.uint64)
+
+        # the op-level contract: aggregate response ≪ block response
+        def resp_bytes(values):
+            buf = bytearray()
+            for v in values:
+                wire._pack_value(buf, v)
+            return len(buf)
+
+        shard = remote.shards[0]
+        agg_resp = shard.call(
+            "dense_feature_udf", [ids, ["wide"], ["udf_mean"]]
+        )
+        block_resp = shard.call("get_dense_feature", [ids, ["wide"]])
+        assert resp_bytes(agg_resp) < resp_bytes(block_resp) / 50
+
+        # the GQL path routes through the pushdown (no full-block fetch)
+        calls = []
+        orig = RemoteShard.call
+
+        def spy(self, op, values):
+            calls.append(op)
+            return orig(self, op, values)
+
+        RemoteShard.call = spy
+        try:
+            res = run_gql(
+                remote, "v(roots).values(udf_mean(wide)).as(f)",
+                {"roots": ids},
+            )
+        finally:
+            RemoteShard.call = orig
+        assert "dense_feature_udf" in calls
+        assert "get_dense_feature" not in calls
+        np.testing.assert_allclose(
+            res["f"].reshape(-1), feats.mean(axis=1), rtol=1e-5
+        )
+
+        # a server that doesn't know the UDF → graceful client-side
+        # fallback with identical results
+        class NoPushdown:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                if name == "get_dense_feature_udf":
+                    raise AttributeError(name)
+                return getattr(self._inner, name)
+
+        res2 = run_gql(
+            NoPushdown(remote), "v(roots).values(udf_mean(wide)).as(f)",
+            {"roots": ids},
+        )
+        np.testing.assert_allclose(res2["f"], res["f"], rtol=1e-6)
+    finally:
+        srv.stop()
 
 
 def test_remote_gql_conditions(cluster, rng):

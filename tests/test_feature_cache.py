@@ -215,12 +215,15 @@ def test_lean_wire_matches_full(tmp_path):
     )
 
 
-def test_lean_downgrades_on_weighted_graph():
-    """lean=True must ship real masks/weights when edge weights aren't 1.0
-    (hydration would otherwise rebuild them as uniform)."""
+def test_lean_ships_bf16_weights_on_weighted_graph():
+    """lean=True on a weighted graph ships bf16 weights next to the int32
+    rows (weighted-lean wire, VERDICT r3 #5) — hydration upcasts to f32
+    and rebuilds masks from row validity, never inventing uniform 1.0s."""
+    import ml_dtypes
     import numpy as np
 
     from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.dataflow.base import hydrate_blocks
     from euler_tpu.graph import Graph
 
     nodes = [
@@ -241,10 +244,14 @@ def test_lean_downgrades_on_weighted_graph():
         g, ["f"], fanouts=[2], rng=np.random.default_rng(0),
         feature_mode="rows", lean=True,
     )
+    assert flow._lean_w
     mb = flow.query(np.asarray([1, 2], np.uint64))
-    assert mb.masks is not None  # downgraded: real arrays shipped
-    assert mb.blocks[0].edge_w is not None
-    assert np.all(mb.blocks[0].edge_w[mb.blocks[0].mask] == 2.0)
+    assert not flow._lean_off  # stays lean
+    assert mb.masks is None  # masks rebuilt on device
+    assert mb.blocks[0].edge_w.dtype == ml_dtypes.bfloat16
+    hyd = hydrate_blocks(mb)
+    b = hyd.blocks[0]
+    assert np.all(np.asarray(b.edge_w)[np.asarray(b.mask)] == 2.0)
 
 
 def test_lean_downgrades_on_dangling_edge():
