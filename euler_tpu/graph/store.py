@@ -54,7 +54,7 @@ def split_hops(n_roots: int, counts, *arrays):
     ]
 
 
-def lean_wire_ok(roots, hop_w, hop_mask, hop_rows) -> bool:
+def lean_wire_ok(roots, hop_w, hop_mask, hop_rows, require_unit_w=True) -> bool:
     """True when a fused-fanout batch satisfies the LEAN-wire invariants:
     unit edge weights (hop_w=None means weights were already proven unit
     cluster-wide, e.g. via unit_edge_weights), no valid root id truncating
@@ -63,9 +63,13 @@ def lean_wire_ok(roots, hop_w, hop_mask, hop_rows) -> bool:
     rebuilds edge_w as 1.0 and derives validity from feature row > 0 /
     int32 root_idx — a batch violating any invariant would silently train
     on wrong values, so the ONE definition of the check is shared by the
-    client flow and the serving coordinator."""
+    client flow and the serving coordinator.
+
+    require_unit_w=False checks only the id/row invariants — the
+    weighted-lean wire (VERDICT r3 #5) ships bf16 edge weights next to the
+    int32 rows instead of downgrading weighted graphs to full wire."""
     roots = np.asarray(roots, dtype=np.uint64)
-    unit_w = hop_w is None or all(
+    unit_w = not require_unit_w or hop_w is None or all(
         np.all(w.reshape(-1)[m.reshape(-1)] == 1.0)
         for w, m in zip(hop_w[1:], hop_mask[1:])
     )
@@ -573,8 +577,19 @@ class GraphStore:
         uniq, inv = np.unique(flat_ids, return_inverse=True)
         wsum = np.zeros(len(uniq))
         np.add.at(wsum, inv, flat_w)
-        sampler = _WeightedSampler(wsum)
-        chosen = np.unique(sampler.sample(count, rng))
+        if len(uniq) <= count:
+            # frontier fits: take every neighbor — the layer is EXACT
+            # (eval batches sized under `count` get GCN-quality inference)
+            chosen = np.arange(len(uniq))
+        else:
+            # weighted sampling WITHOUT replacement (Gumbel top-k):
+            # sampling with replacement + unique would concentrate on the
+            # few heaviest candidates and shrink the effective layer far
+            # below `count`, starving aggregation coverage
+            keys = np.log(np.maximum(wsum, 1e-30)) + rng.gumbel(
+                size=len(uniq)
+            )
+            chosen = np.sort(np.argpartition(-keys, count - 1)[:count])
         layer = np.full(count, DEFAULT_ID, dtype=np.uint64)
         layer[: len(chosen)] = uniq[chosen]
         lmask = layer != DEFAULT_ID
@@ -601,6 +616,15 @@ class GraphStore:
         """[n, sum(dims)] f32; missing nodes → zeros."""
         rows = self.lookup(ids)
         return self._dense_by_rows(rows, names, node=True)
+
+    def get_dense_feature_udf(self, ids, names, udfs):
+        """Per (name, udf) pair: aggregate the feature block in place and
+        return ([n, sum(k_i)], widths) — the server-side half of remote
+        `values(udf_*)` (udf.h / API_GET_P semantics: ship the aggregate,
+        not the block)."""
+        from euler_tpu.query.gql import dense_feature_udf
+
+        return dense_feature_udf(self, ids, names, udfs)
 
     def get_dense_by_rows(self, rows, names) -> np.ndarray:
         """Dense node features by pre-resolved local rows (-1 → zeros);
@@ -1455,6 +1479,31 @@ class Graph:
 
     def get_dense_feature(self, ids, names) -> np.ndarray:
         return self._scatter_gather(ids, lambda sh, i: sh.get_dense_feature(i, names))
+
+    def get_dense_feature_udf(self, ids, names, udfs):
+        """Rows are aggregated independently (axis=1), so each owner shard
+        runs the UDF on its own rows and only the aggregates are gathered
+        — for remote shards this is the server-side UDF pushdown."""
+        from euler_tpu.query.gql import dense_feature_udf
+
+        # every shard reports identical widths (differing column counts
+        # would already fail _scatter_gather's template-shaped scatter);
+        # capture any one result's
+        widths_box: list = []
+
+        def fn(sh, i):
+            pushdown = getattr(sh, "get_dense_feature_udf", None)
+            out, w = (
+                pushdown(i, names, udfs)
+                if pushdown is not None
+                else dense_feature_udf(sh, i, names, udfs)
+            )
+            if not widths_box:
+                widths_box.append(np.asarray(w, np.int64))
+            return out
+
+        gathered = self._scatter_gather(ids, fn)
+        return gathered, widths_box[0]
 
     def _shard_row_offsets(self) -> np.ndarray:
         if not all(hasattr(s, "num_nodes") for s in self.shards):

@@ -81,6 +81,53 @@ def unregister_udf(name: str) -> None:
     _UDFS.pop(name, None)
 
 
+def apply_udf(name: str, block: np.ndarray) -> np.ndarray:
+    """Run registered UDF `name` on a fetched [n, dim] block → [n, k].
+
+    Shared by the client-side values() tail and the serving shard's
+    dense_feature_udf op (server-side aggregation, udf.h API_GET_P
+    semantics) so both sides validate shapes identically."""
+    if name not in _UDFS:
+        raise ValueError(f"unknown UDF {name!r}")
+    n_rows = block.shape[0]
+    out = np.asarray(_UDFS[name](block), dtype=np.float32)
+    if out.ndim == 1:
+        out = out.reshape(-1, 1)
+    if out.ndim != 2 or out.shape[0] != n_rows:
+        raise ValueError(
+            f"UDF {name!r} returned shape {out.shape}; expected"
+            f" [{n_rows}] or [{n_rows}, k] (one row per frontier node —"
+            " aggregate over axis=1)"
+        )
+    return out
+
+
+def dense_feature_udf(graph, ids, names, udfs):
+    """Aggregated dense-feature fetch: per (name, udf) pair, fetch the
+    feature block for `ids` and return only the aggregates:
+    ([n, sum(k_i)] f32, [k_i...] int64 per-pair column widths).
+
+    This is what a serving shard executes for remote `values(udf_*)`
+    (the reference runs UDFs on the shard that owns the data and ships
+    only the aggregate — euler/core/framework/udf.h, API_GET_P kernels);
+    the wire then carries k columns instead of the feature dim."""
+    ids = np.asarray(ids, np.uint64)
+    names = list(names)
+    widths = [graph.meta.feature_spec(nm, node=True).dim for nm in names]
+    flat = graph.get_dense_feature(ids, names)
+    offs = np.r_[0, np.cumsum(widths)]
+    cols = [
+        apply_udf(udf, flat[:, offs[k] : offs[k + 1]])
+        for k, udf in enumerate(udfs)
+    ]
+    out = (
+        np.concatenate(cols, axis=1)
+        if cols
+        else np.zeros((len(ids), 0), np.float32)
+    )
+    return out, np.asarray([c.shape[1] for c in cols], np.int64)
+
+
 def _tokenize(src: str):
     src = src.strip()
     pos = 0
@@ -369,35 +416,80 @@ class Query:
                 ]
                 if names:
                     on_edges = cur_edges is not None
-                    widths = [
-                        graph.meta.feature_spec(nm, node=not on_edges).dim
-                        for nm in names
+                    udf_idx = [
+                        k for k, a in enumerate(args)
+                        if isinstance(a, tuple) and a[0] == "()"
                     ]
-                    flat = (
-                        graph.get_edge_dense_feature(cur_edges, names)
-                        if on_edges
-                        else graph.get_dense_feature(cur, names)
-                    )
-                    offs = np.r_[0, np.cumsum(widths)]
-                    cols = []
-                    for k, a in enumerate(args):
-                        block = flat[:, offs[k] : offs[k + 1]]
-                        if isinstance(a, tuple) and a[0] == "()":
-                            if a[1] not in _UDFS:
-                                raise ValueError(f"unknown UDF {a[1]!r}")
-                            n_rows = block.shape[0]
-                            block = np.asarray(
-                                _UDFS[a[1]](block), dtype=np.float32
+                    pushdown = getattr(graph, "get_dense_feature_udf", None)
+                    udf_cols = None
+                    if udf_idx and not on_edges and pushdown is not None:
+                        # server-side aggregation (udf.h semantics): the
+                        # owning shard runs the UDF and ships only the
+                        # aggregate columns. A server that doesn't know
+                        # the (client-registered) UDF raises; fall back
+                        # to fetching the block and aggregating here.
+                        try:
+                            agg, agg_w = pushdown(
+                                cur,
+                                [names[k] for k in udf_idx],
+                                [args[k][1] for k in udf_idx],
                             )
-                            if block.ndim == 1:
-                                block = block.reshape(-1, 1)
-                            if block.ndim != 2 or block.shape[0] != n_rows:
-                                raise ValueError(
-                                    f"UDF {a[1]!r} returned shape "
-                                    f"{block.shape}; expected [{n_rows}] or "
-                                    f"[{n_rows}, k] (one row per frontier "
-                                    "node — aggregate over axis=1)"
-                                )
+                        except (RuntimeError, ValueError) as e:
+                            # only capability gaps fall back: a server
+                            # predating the op ("unknown op ...") or one
+                            # without this client-registered UDF
+                            # ("unknown UDF ..."); genuine execution
+                            # failures must surface, not be silently
+                            # recomputed client-side
+                            s = str(e)
+                            if "unknown op" not in s and (
+                                "unknown UDF" not in s
+                            ):
+                                raise
+                            agg = None
+                        if agg is not None:
+                            # split the concatenated aggregate back into
+                            # per-arg columns by the reported widths (a
+                            # UDF may return k>1 columns)
+                            ao = np.r_[0, np.cumsum(agg_w)]
+                            udf_cols = [
+                                agg[:, ao[i] : ao[i + 1]]
+                                for i in range(len(udf_idx))
+                            ]
+                    fetch_idx = [
+                        k for k in range(len(args))
+                        if udf_cols is None or k not in udf_idx
+                    ]
+                    flat = None
+                    offs = None
+                    if fetch_idx:
+                        fetch_names = [names[k] for k in fetch_idx]
+                        widths = [
+                            graph.meta.feature_spec(
+                                nm, node=not on_edges
+                            ).dim
+                            for nm in fetch_names
+                        ]
+                        flat = (
+                            graph.get_edge_dense_feature(
+                                cur_edges, fetch_names
+                            )
+                            if on_edges
+                            else graph.get_dense_feature(cur, fetch_names)
+                        )
+                        offs = np.r_[0, np.cumsum(widths)]
+                    cols = []
+                    fpos = 0
+                    upos = 0
+                    for k, a in enumerate(args):
+                        if udf_cols is not None and k in udf_idx:
+                            cols.append(udf_cols[upos])
+                            upos += 1
+                            continue
+                        block = flat[:, offs[fpos] : offs[fpos + 1]]
+                        fpos += 1
+                        if isinstance(a, tuple) and a[0] == "()":
+                            block = apply_udf(a[1], block)
                         cols.append(block)
                     last = np.concatenate(cols, axis=1)
                 else:
