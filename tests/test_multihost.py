@@ -36,3 +36,57 @@ def test_two_process_matches_single_process():
     )["losses"]
     np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
     assert multi[-1] < multi[0]  # it actually trains
+
+
+def test_multihost_trainers_with_remote_graph_service(tmp_path):
+    """The full reference topology in miniature (VERDICT r3 #7,
+    dist_tf_euler.sh:2-43 + start_service.py:70-80): 2 jax.distributed
+    trainer processes pull LEAN one-RPC minibatches from 2 GraphService
+    processes, and the loss trajectory matches a 1-process trainer
+    replaying the same slotted global stream against the same servers."""
+    from euler_tpu.datasets.synthetic import random_graph
+    from euler_tpu.distributed import Registry
+    from euler_tpu.graph import format as tformat
+
+    # sharded on-disk graph the services serve and trainers bootstrap
+    # their feature cache from
+    g = random_graph(
+        num_nodes=400, out_degree=6, feat_dim=8, num_partitions=2, seed=0
+    )
+    data = str(tmp_path / "data")
+    os.makedirs(data, exist_ok=True)
+    for p, sh in enumerate(g.shards):
+        tformat.write_arrays(os.path.join(data, f"part_{p}"), sh.arrays)
+    g.meta.save(data)
+    reg = str(tmp_path / "reg")
+
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    servers = [
+        subprocess.Popen(
+            [sys.executable, "-m", "euler_tpu.distributed.service",
+             "--data", data, "--shard", str(i), "--registry", reg,
+             "--no-native"],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        for i in range(2)
+    ]
+    try:
+        Registry(reg).wait_for(2, timeout=60.0)
+        mod = "euler_tpu.examples.run_multihost"
+        common = ["--steps", "4", "--batch", "32", "--remote-data", data,
+                  "--remote-registry", reg, "--remote-shards", "2",
+                  "--slots", "2"]
+        multi = _run(
+            [sys.executable, "-m", mod, "--spawn", "2",
+             "--port", "12394", *common]
+        )["multihost_losses"]
+        single = _run([sys.executable, "-m", mod, *common])["losses"]
+        np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
+        assert multi[-1] < multi[0]  # it actually trains
+    finally:
+        for p in servers:
+            p.kill()
+        for p in servers:
+            p.wait(timeout=10)
