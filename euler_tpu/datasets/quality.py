@@ -59,6 +59,7 @@ def fb15k_like(
     tail_cands: int = 4,
     noise_frac: float = 0.25,
     seed: int = 0,
+    projective: bool = False,
 ) -> tuple[dict, np.ndarray]:
     """Calibrated KG stand-in for the TransX quality bands.
 
@@ -73,11 +74,31 @@ def fb15k_like(
     39.7%) while untrained embeddings stay at MeanRank ≈ n_ent/2 — the
     control that separates "learned the structure" from "easy dataset".
 
+    projective=True plants PER-RELATION SUBSPACE structure instead:
+    each relation owns an orthogonal map P_r and tails sit near
+    P_r·E[h] + R[r]. A pure translation (TransE) underfits this geometry
+    while projection variants (TransR/TransD) can represent it exactly —
+    the discriminating control for the projection machinery, mirroring
+    how TransR out-Hit@10s TransE on real FB15k
+    (examples/TransX/README.md:43-48).
+
     Returns (graph_json, test_triples int32 [n_test, 3] of (h, r, t)).
     """
     rng = np.random.default_rng(seed)
     E = rng.uniform(-1.0, 1.0, (n_ent, dim))
     R = rng.uniform(-0.6, 0.6, (n_rel, dim))
+    if projective:
+        # per-relation linear map: an equal blend of identity and a
+        # random orthogonal matrix (QR of a gaussian) — NOT itself
+        # orthogonal; the identity component keeps tails correlated with
+        # heads so the structure stays learnable, the orthogonal
+        # component rotates each relation into its own subspace
+        P = np.empty((n_rel, dim, dim))
+        for k in range(n_rel):
+            q, _ = np.linalg.qr(rng.normal(size=(dim, dim)))
+            P[k] = 0.5 * np.eye(dim) + 0.5 * q
+    else:
+        P = None
 
     def make_triples(count):
         h = rng.integers(0, n_ent, count)
@@ -86,7 +107,13 @@ def fb15k_like(
         # nearest-entity tails in chunks (count × n_ent distance matrix)
         for lo in range(0, count, 4096):
             hi = min(lo + 4096, count)
-            target = E[h[lo:hi]] + R[r[lo:hi]]
+            if P is not None:
+                target = (
+                    np.einsum("bd,bde->be", E[h[lo:hi]], P[r[lo:hi]])
+                    + R[r[lo:hi]]
+                )
+            else:
+                target = E[h[lo:hi]] + R[r[lo:hi]]
             d2 = ((target[:, None, :] - E[None, :, :]) ** 2).sum(-1)
             near = np.argpartition(d2, tail_cands, axis=1)[:, :tail_cands]
             pick = rng.integers(0, tail_cands, hi - lo)

@@ -74,7 +74,12 @@ class Estimator:
         cfg: EstimatorConfig | None = None,
         mesh=None,
         feature_cache=None,
+        init_params=None,
     ):
+        """init_params: warm-start parameter pytree (already unboxed) —
+        overrides model.init at first train/eval. Staged recipes use this:
+        e.g. TransR/TransD initialized from a trained TransE's tables
+        (the published TransR training protocol)."""
         self.model = model
         self.batch_fn = batch_fn
         self.cfg = cfg or EstimatorConfig()
@@ -83,6 +88,7 @@ class Estimator:
         # hydrated to dense features on device, inside the jitted step
         self.feature_cache = feature_cache
         self.params = None
+        self._init_params = init_params
         self.opt_state = None
         self.step = 0
         self.tx = make_optimizer(self.cfg)
@@ -120,9 +126,15 @@ class Estimator:
 
     def _ensure_init(self):
         if self.params is not None:
+            if self.opt_state is None:
+                self.opt_state = self.tx.init(self.params)
             return
         import flax.linen as nn
 
+        if self._init_params is not None and self.mesh is None:
+            self.params = self._init_params
+            self.opt_state = self.tx.init(self.params)
+            return
         batch = self._put(
             self.batch_fn(), stacked=self.cfg.steps_per_call > 1
         )
@@ -138,6 +150,18 @@ class Estimator:
             from euler_tpu.parallel import unbox_and_shard
 
             params, _ = unbox_and_shard(self.mesh, params)
+            if self._init_params is not None:
+                # warm-start under a mesh: the cold init above provides
+                # the placement template (row-sharded tables etc.); the
+                # warm values are device_put onto the same shardings so
+                # model parallelism survives the warm start
+                params = jax.tree_util.tree_map(
+                    lambda tgt, src: jax.device_put(
+                        jnp.asarray(src), tgt.sharding
+                    ),
+                    params,
+                    self._init_params,
+                )
         else:
             params = nn.meta.unbox(params)
         self.params = params

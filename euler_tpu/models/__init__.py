@@ -8,7 +8,12 @@ from euler_tpu.models.graphsage import (  # noqa: F401
     GraphSAGEUnsupervised,
 )
 from euler_tpu.models.graph_clf import GraphClassifier  # noqa: F401
-from euler_tpu.models.kg import TransX, kg_batches, kg_rank_eval  # noqa: F401
+from euler_tpu.models.kg import (  # noqa: F401
+    TransX,
+    kg_batches,
+    kg_rank_eval,
+    transx_warm_start,
+)
 from euler_tpu.models.layerwise_models import LayerwiseGCN  # noqa: F401
 from euler_tpu.models.rgcn import RGCNSupervised  # noqa: F401
 from euler_tpu.models.autoencoders import DGI, GAE, dgi_batches, gae_batches  # noqa: F401

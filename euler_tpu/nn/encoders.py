@@ -20,15 +20,21 @@ from euler_tpu.ops import gather
 
 
 class Embedding(nn.Module):
-    """Sharded id-embedding table: rows partitioned over the 'model' axis."""
+    """Sharded id-embedding table: rows partitioned over the 'model' axis.
+
+    row_init overrides the default normal(0.02) row initializer — KG
+    models use this to start relation projections at identity/zero so
+    TransR/D begin as TransE (the published training recipe). (Named
+    row_init, not init: flax reserves Module.init.)"""
 
     vocab: int
     dim: int
     partitioned: bool = True
+    row_init: object = None
 
     @nn.compact
     def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
-        init = nn.initializers.normal(stddev=0.02)
+        init = self.row_init or nn.initializers.normal(stddev=0.02)
         if self.partitioned:
             init = nn.with_partitioning(init, ("model", None))
         # rows padded to a 128 multiple: shardable by any practical model-axis

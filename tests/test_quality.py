@@ -14,6 +14,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# second-tier gate: `pytest -m quality --override-ini addopts=` (VERDICT r3 #3)
+pytestmark = pytest.mark.quality
+
 from euler_tpu.datasets.quality import cora_like_json
 from euler_tpu.dataflow import FullGraphFlow
 from euler_tpu.estimator import Estimator, EstimatorConfig
@@ -370,21 +373,21 @@ def test_transe_fb15k_like(tmp_path):
     )
 
 
-def test_gin_mutag_like(tmp_path):
-    """GIN published mutag accuracy 0.923 (examples/gin/README.md). The
-    stand-in's classes differ only relationally (same label histogram,
-    same degrees) — measured 0.9375 with a label-histogram logistic
-    regression control at chance (0.526)."""
-    import jax
-    import jax.numpy as jnp
-
+@pytest.fixture(scope="module")
+def mutag_like():
     from euler_tpu.datasets.quality import mutag_like_json
-    from euler_tpu.dataflow import WholeGraphDataFlow
     from euler_tpu.graph import Graph
-    from euler_tpu.models import GraphClassifier
 
     j = mutag_like_json()
-    g = Graph.from_json(j)
+    return Graph.from_json(j)
+
+
+def _mutag_clf_acc(g, conv, pool, tmp_path, steps=300, lr=0.01, dims=(32, 32)):
+    """Shared mutag-family probe: train a GraphClassifier on the 80/20
+    split of the relational stand-in, return held-out accuracy."""
+    from euler_tpu.dataflow import WholeGraphDataFlow
+    from euler_tpu.models import GraphClassifier
+
     labels = sorted(
         g.meta.graph_labels, key=lambda s: int(s[1:].split("_")[0])
     )
@@ -395,10 +398,10 @@ def test_gin_mutag_like(tmp_path):
     flow = WholeGraphDataFlow(g, ["feature"], max_nodes=24, max_degree=12)
     assert flow.num_classes == 2  # "_c<k>" class parsing
     model = GraphClassifier(
-        conv="gin", dims=[32, 32], num_classes=2, pool="add"
+        conv=conv, dims=list(dims), num_classes=2, pool=pool
     )
     cfg = EstimatorConfig(
-        model_dir=str(tmp_path / "gin"), learning_rate=0.01,
+        model_dir=str(tmp_path / f"{conv}_{pool}"), learning_rate=lr,
         log_steps=10**9,
     )
 
@@ -406,12 +409,27 @@ def test_gin_mutag_like(tmp_path):
         return (flow.query(rng.choice(tr, size=16, replace=False)),)
 
     est = Estimator(model, batch_fn, cfg)
-    est.train(total_steps=300, save=False, log=False)
+    est.train(total_steps=steps, save=False, log=False)
     evals = [
         (flow.query(te[i : i + 16]),) for i in range(0, len(te) - 15, 16)
     ]
-    acc = est.evaluate(evals)["acc"]
+    return est.evaluate(evals)["acc"], perm
+
+
+def test_gin_mutag_like(mutag_like, tmp_path):
+    """GIN published mutag accuracy 0.923 (examples/gin/README.md). The
+    stand-in's classes differ only relationally (same label histogram,
+    same degrees) — measured 0.9375 with a label-histogram logistic
+    regression control at chance (0.526)."""
+    import jax
+    import jax.numpy as jnp
+
+    g = mutag_like
+    acc, perm = _mutag_clf_acc(g, "gin", "add", tmp_path)
     assert 0.85 < acc <= 1.0, f"GIN acc {acc:.3f} out of calibrated band"
+    labels = sorted(
+        g.meta.graph_labels, key=lambda s: int(s[1:].split("_")[0])
+    )
 
     # histogram-LR control: same information minus the graph structure
     hists, ys = [], []
@@ -445,4 +463,315 @@ def test_gin_mutag_like(tmp_path):
     assert ctl < 0.68, (
         f"histogram control {ctl:.3f} too strong — structure signal leaked"
         " into the label histograms"
+    )
+
+
+@pytest.mark.parametrize(
+    "name,conv,pool,published,lo,hi",
+    [
+        # published mutag accuracies: examples/<name>/README.md; measured
+        # on the relational stand-in, seed 0 (histogram control at chance,
+        # asserted in test_gin_mutag_like on the same graph):
+        # set2set 0.906 (published 0.901), gated_graph 0.875 (0.920 — the
+        # GRU conv pays the stand-in's pendant noise slightly more),
+        # graphgcn 0.906 (0.891)
+        ("set2set", "gin", "set2set", 0.901, 0.85, 0.97),
+        ("gated_graph", "gated", "mean", 0.920, 0.82, 0.95),
+        ("graphgcn", "gcn", "attention", 0.891, 0.85, 0.97),
+    ],
+)
+def test_graph_clf_family_mutag_like(
+    mutag_like, tmp_path, name, conv, pool, published, lo, hi
+):
+    """Graph-classification family bands vs the published mutag table
+    (examples/set2set, examples/gated_graph, examples/graphgcn):
+    Set2Set = LSTM-attention readout, GatedGraph = GRU conv, GraphGCN =
+    GCN conv + attention pooling — same zoo wiring as examples/run_model.py
+    GRAPH_CLF."""
+    acc, _ = _mutag_clf_acc(mutag_like, conv, pool, tmp_path)
+    assert lo < acc <= hi, (
+        f"{name} acc {acc:.3f} out of calibrated band (published {published})"
+    )
+
+
+@pytest.fixture(scope="module")
+def fb15k_like_data():
+    from euler_tpu.datasets.quality import fb15k_like
+    from euler_tpu.graph import Graph
+
+    j, test = fb15k_like()
+    return Graph.from_json(j), test
+
+
+@pytest.fixture(scope="module")
+def trained_transe(fb15k_like_data, tmp_path_factory):
+    """TransE trained on the KG stand-in — shared by the TransH/D direct
+    probes' sibling and the staged TransR recipe."""
+    from euler_tpu.models import TransX, kg_batches
+
+    g, _ = fb15k_like_data
+    rng = np.random.default_rng(0)
+    model = TransX(
+        num_entities=2001, num_relations=40, dim=32, variant="transe"
+    )
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path_factory.mktemp("kg") / "transe"),
+        learning_rate=0.05, log_steps=10**9,
+    )
+    est = Estimator(model, kg_batches(g, 512, num_negs=8, rng=rng), cfg)
+    est.train(total_steps=1500, save=False, log=False)
+    return model, est.params
+
+
+@pytest.mark.parametrize(
+    "variant,published_mr,published_hit,mr_hi,hit_lo",
+    [
+        # published FB15k rows: examples/TransX/README.md:46-48. The
+        # stand-in's planted translational structure is exactly the
+        # geometry trans* variants model, so each variant must reach the
+        # TransE-level band; the untrained control (asserted in
+        # test_transe_fb15k_like, same dataset) pins the noise floor.
+        # Measured seed 0: transh passes direct; transd MR 250 /
+        # Hit@10 0.382 (post-projection normalization, transD.py:53).
+        ("transh", 179, 0.454, 420, 0.32),
+        ("transd", 163, 0.513, 420, 0.32),
+    ],
+)
+def test_transx_variants_fb15k_like(
+    fb15k_like_data, tmp_path, variant, published_mr, published_hit,
+    mr_hi, hit_lo
+):
+    """TransH/D MeanRank + Hit@10 bands on the calibrated KG stand-in
+    (see test_transe_fb15k_like for the dataset's construction and the
+    published-number mapping)."""
+    from euler_tpu.models import TransX, kg_batches, kg_rank_eval
+
+    g, test = fb15k_like_data
+    rng = np.random.default_rng(0)
+    model = TransX(
+        num_entities=2001, num_relations=40, dim=32, variant=variant
+    )
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / variant), learning_rate=0.05,
+        log_steps=10**9,
+    )
+    est = Estimator(model, kg_batches(g, 512, num_negs=8, rng=rng), cfg)
+    est.train(total_steps=1500, save=False, log=False)
+    r = kg_rank_eval(model, est.params, test[:500], num_entities=2000)
+    assert 30 < r["mean_rank"] < mr_hi, (
+        f"{variant} MeanRank {r['mean_rank']:.0f} out of band"
+        f" (published {published_mr})"
+    )
+    assert hit_lo < r["hit@10"] < 0.60, (
+        f"{variant} Hit@10 {r['hit@10']:.3f} out of band"
+        f" (published {published_hit})"
+    )
+
+
+def test_projective_kg_standin_defeats_pure_translation(tmp_path):
+    """Control for the projective KG stand-in (fb15k_like projective=True,
+    per-relation subspace maps): a pure translation model must score
+    measurably WORSE there than on the translational stand-in — proving
+    the planted subspace structure is real, not decorative. Measured
+    seed 0: TransE MR 287 translational vs 376 projective, Hit@10 0.414
+    vs 0.200."""
+    from euler_tpu.datasets.quality import fb15k_like
+    from euler_tpu.graph import Graph
+    from euler_tpu.models import TransX, kg_batches, kg_rank_eval
+
+    j, test = fb15k_like(projective=True)
+    g = Graph.from_json(j)
+    rng = np.random.default_rng(0)
+    model = TransX(
+        num_entities=2001, num_relations=40, dim=32, variant="transe"
+    )
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "proj_te"), learning_rate=0.05,
+        log_steps=10**9,
+    )
+    est = Estimator(model, kg_batches(g, 512, num_negs=8, rng=rng), cfg)
+    est.train(total_steps=1500, save=False, log=False)
+    r = kg_rank_eval(model, est.params, test[:500], num_entities=2000)
+    # clearly learned (far under the n/2=1000 random MeanRank), but
+    # clearly short of the translational stand-in's TransE band ceiling
+    assert 200 < r["mean_rank"] < 700, r
+    assert 0.10 < r["hit@10"] < 0.32, (
+        f"projective Hit@10 {r['hit@10']:.3f} — structure no longer "
+        "defeats pure translation; recalibrate"
+    )
+
+
+def test_transr_staged_fb15k_like(fb15k_like_data, trained_transe, tmp_path):
+    """TransR (published FB15k MR 191 / Hit@10 0.461) via the published
+    staged recipe: the original TransR paper and the reference's OpenKE
+    comparison both initialize TransR from a trained TransE (projections
+    start as identity via this repo's eye-init, so step 0 == the TransE
+    optimum); training from random projections was measured to scramble
+    the geometry (MR 510-699 across lr sweeps vs 320 staged). Measured
+    seed 0: MR 320 / Hit@10 0.362."""
+    from euler_tpu.models import (
+        TransX,
+        kg_batches,
+        kg_rank_eval,
+        transx_warm_start,
+    )
+
+    g, test = fb15k_like_data
+    te_model, te_params = trained_transe
+    model = TransX(
+        num_entities=2001, num_relations=40, dim=32, variant="transr"
+    )
+    b = kg_batches(g, 512, num_negs=8, rng=np.random.default_rng(1))()[0]
+    p = transx_warm_start(model, te_params, b)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "transr"), learning_rate=0.005,
+        log_steps=10**9,
+    )
+    est = Estimator(
+        model, kg_batches(g, 512, num_negs=8, rng=np.random.default_rng(2)),
+        cfg, init_params=p,
+    )
+    est.train(total_steps=800, save=False, log=False)
+    r = kg_rank_eval(model, est.params, test[:500], num_entities=2000)
+    assert 30 < r["mean_rank"] < 420, (
+        f"staged TransR MeanRank {r['mean_rank']:.0f} out of band"
+        " (published 191)"
+    )
+    assert 0.30 < r["hit@10"] < 0.60, (
+        f"staged TransR Hit@10 {r['hit@10']:.3f} out of band"
+        " (published 0.461)"
+    )
+
+
+@pytest.mark.parametrize(
+    "name,layer_sizes,batch,steps,published,lo,hi",
+    [
+        # FastGCN published cora F1 0.803 (examples/fastgcn/README.md):
+        # importance-sampled fixed per-layer candidate sets — measured
+        # 0.811 (seed 0). AdaptiveGCN (AS-GCN) 0.821
+        # (examples/adaptivegcn/README.md) adapts the layer budget to the
+        # batch; the TPU analog is the same dense layerwise flow with a
+        # larger candidate set — measured 0.803. Both use the documented
+        # 640-label pool: the self-feature path memorizes the stand-in's
+        # near-unique bag-of-words rows at 140 labels exactly like
+        # GraphSAGE/DNA/GeniePath (test_graphsage_cora_f1 protocol note).
+        ("fastgcn", (256, 256), 64, 400, 0.803, 0.74, 0.88),
+        ("adaptivegcn", (400, 400), 128, 600, 0.821, 0.74, 0.88),
+    ],
+)
+def test_layerwise_cora_f1(cora_like, tmp_path, name, layer_sizes, batch,
+                           steps, published, lo, hi):
+    """Layerwise (FastGCN/AS-GCN) family bands on the cora stand-in:
+    dense per-layer candidate sets + [n_l, n_{l+1}] adjacency matmuls
+    (the MXU-native form of API_SAMPLE_L, sample_layer_op.cc:83).
+    Candidates are Gumbel-top-k weighted WITHOUT replacement; 64-root
+    eval batches make the layers exact (store.py
+    sample_neighbor_layerwise)."""
+    from euler_tpu.dataflow import LayerwiseDataFlow
+    from euler_tpu.models import LayerwiseGCN
+
+    g, _, _, types = cora_like
+    tr_ids, te_ids = _splits(types, train_pool=(0, 1))
+    rng = np.random.default_rng(0)
+    flow = LayerwiseDataFlow(
+        g, ["feature"], layer_sizes=list(layer_sizes),
+        label_feature="label", rng=rng,
+    )
+    model = LayerwiseGCN(dims=[32, 32], label_dim=7)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / name), learning_rate=0.02, log_steps=10**9
+    )
+
+    def batch_fn():
+        roots = rng.choice(tr_ids, size=batch, replace=True)
+        return (flow.query(roots),)
+
+    est = Estimator(model, batch_fn, cfg)
+    est.train(total_steps=steps, save=False, log=False)
+    evals = [
+        (flow.query(te_ids[i : i + 64]),) for i in range(0, 1000, 64)
+    ]
+    f1 = est.evaluate(evals)["f1"]
+    assert lo < f1 < hi, (
+        f"{name} f1 {f1:.3f} out of calibrated band (published {published})"
+    )
+
+
+def test_lgcn_cora_f1(cora_like, tmp_path):
+    """LGCN published cora F1 0.641 (examples/lgcn/README.md) — the
+    lowest published conv row; its per-channel top-k loses information on
+    sparse bag-of-words features by design. The probe mirrors the
+    reference protocol exactly: ONE LGCN layer over the root's 10 sampled
+    neighbors (LGCEncoder, encoders.py:872-922: k=3, hidden 128, out 64,
+    batch 32, lr 0.01) — not a stacked 2-hop conv. Measured seed 0:
+    0.781 on the 640-label pool (0.512 at 140 labels — the one-hop
+    self-path memorizes the stand-in's near-unique features like
+    GraphSAGE's does; see test_graphsage_cora_f1)."""
+    g, _, _, types = cora_like
+    tr_ids, te_ids = _splits(types, train_pool=(0, 1))
+    rng = np.random.default_rng(0)
+    from euler_tpu.dataflow import SageDataFlow
+
+    flow = SageDataFlow(
+        g, ["feature"], fanouts=[10], label_feature="label", rng=rng
+    )
+    model = SuperviseModel(conv="lgcn", dims=[64], label_dim=7)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "lgcn"), learning_rate=0.01,
+        log_steps=10**9,
+    )
+
+    def batch_fn():
+        return (flow.query(rng.choice(tr_ids, size=32, replace=True)),)
+
+    est = Estimator(model, batch_fn, cfg)
+    est.train(total_steps=200, save=False, log=False)
+    evals = [
+        (flow.query(te_ids[i : i + 200]),) for i in range(0, 1000, 200)
+    ]
+    f1 = est.evaluate(evals)["f1"]
+    assert 0.70 < f1 < 0.86, (
+        f"LGCN f1 {f1:.3f} out of calibrated band (published 0.641)"
+    )
+
+
+@pytest.mark.parametrize(
+    "variational,published,lo,hi",
+    [
+        # examples/gae/README.md: GAE 0.71, VGAE 0.79 (cora). Metric is
+        # held-out link-prediction AUC (pos edges vs sampled negatives).
+        # Measured seed 0: GAE 0.820, VGAE 0.763 (the KL term costs AUC
+        # on the stand-in's denser edges, as on real cora it gains).
+        (False, 0.71, 0.74, 0.92),
+        (True, 0.79, 0.70, 0.90),
+    ],
+)
+def test_gae_vgae_cora_like(cora_like, tmp_path, variational, published,
+                            lo, hi):
+    """GAE/VGAE link-prediction bands on the cora stand-in: GCN encoder +
+    inner-product decoder trained on sampled edges, evaluated as AUC of
+    positive vs negative held-out pairs."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.models import GAE, gae_batches
+
+    g, *_ = cora_like
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(g, ["feature"], fanouts=[10], rng=rng)
+    model = GAE(dims=[32], variational=variational)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / ("vgae" if variational else "gae")),
+        learning_rate=0.01, log_steps=10**9,
+    )
+    est = Estimator(
+        model, gae_batches(g, flow, 128, rng=rng), cfg
+    )
+    est.train(total_steps=400, save=False, log=False)
+    # held-out AUC: fresh sampled edges (graph is undirected; the train
+    # stream saw a random subset) vs random pairs
+    evals = [gae_batches(g, flow, 256, rng=np.random.default_rng(7))()
+             for _ in range(4)]
+    auc_v = est.evaluate(evals)["auc"]
+    assert lo < auc_v < hi, (
+        f"{'VGAE' if variational else 'GAE'} auc {auc_v:.3f} out of band"
+        f" (published {published})"
     )
