@@ -6,6 +6,20 @@ so graph data and sample files can live on either. Here the same seam is a
 path-scheme dispatch: `hdfs://` paths go through pyarrow's HadoopFileSystem
 when available (gated — this image has no HDFS), everything else through
 the local filesystem.
+
+Tested contract (pinned; see tests/test_backends_io.py): the hdfs branch
+is exercised against a STUB pyarrow.fs backed by a local dir — covering
+scheme dispatch, URI→(filesystem, path) resolution, input/append/output
+stream selection, text wrapping, exists()/listdir()/walk translation, and
+the no-pyarrow RuntimeError gate. What is asserted is therefore exactly
+the adapter logic between this module and the pyarrow FileSystem API
+surface it calls (open_input_stream / open_append_stream /
+open_output_stream / get_file_info / FileSelector). It has NOT been run
+against a real HDFS namenode: pyarrow's own libhdfs binding is trusted to
+implement that API; connection config (HADOOP_HOME, CLASSPATH,
+fs.defaultFS) is the deployment's responsibility. Anyone wiring a real
+cluster should run tests/test_backends_io.py's roundtrip against an
+hdfs:// URI as the acceptance check — the test body is cluster-agnostic.
 """
 
 from __future__ import annotations
