@@ -239,9 +239,17 @@ def run(platform: str) -> tuple[float, dict]:
         # batch 1024 keeps the MXU matmuls large; the metric is absolute
         # edges/s vs the fixed 2M north star, not an A/B of configs
         # enough measured calls (30) that steady-state host sampling, not
-        # the prefetch queue's head start, dominates the window
-        num_nodes, out_degree, feat_dim = 200_000, 15, 64
-        batch_size, fanouts, dims = 1024, [10, 10], [128, 128]
+        # the prefetch queue's head start, dominates the window.
+        # EULER_BENCH_FEAT_DIM / EULER_BENCH_DIMS override the model
+        # widths for A/B runs (e.g. the wide-F Pallas validation:
+        # DIMS=256,256 with EULER_TPU_PALLAS=off vs =pallas).
+        num_nodes, out_degree = 200_000, 15
+        feat_dim = int(os.environ.get("EULER_BENCH_FEAT_DIM", 64))
+        dims = [
+            int(x)
+            for x in os.environ.get("EULER_BENCH_DIMS", "128,128").split(",")
+        ]
+        batch_size, fanouts = 1024, [10, 10]
         warmup, steps, steps_per_call = 32, 480, 16
 
     rng = np.random.default_rng(0)

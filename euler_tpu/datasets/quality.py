@@ -50,6 +50,35 @@ def pubmed_like_json(seed: int = 0) -> dict:
     )
 
 
+def citeseer_like_json(seed: int = 0) -> dict:
+    """Citeseer-shaped stand-in: 3327 nodes, 6 classes, 3703-dim sparse
+    features, sparse citation graph (avg degree 2.8), 20-per-class split.
+    Calibrated (seed 0) to the published citeseer pair the same way
+    cora_like/pubmed_like are:
+      - logistic regression on raw features  0.592 (citeseer LR ~0.60)
+      - 2-layer true-degree GCN              0.744 (published 0.752,
+        examples/gcn/README.md)
+    The knobs: word_sigma 0.75 (6-class topic overlap over the wide
+    3703-word vocabulary), homophily 0.78 (citeseer's raw homophily
+    ~0.74; the sparse degree-2.8 graph needs most edges informative for
+    the small published GCN-over-LR gap to appear at all — at
+    homophily 0.5 the noisy edges of a degree-2.8 graph make GCN WORSE
+    than the feature baseline)."""
+    return cora_like_json(
+        num_nodes=3327,
+        num_classes=6,
+        feature_dim=3703,
+        avg_degree=2.8,
+        homophily=0.78,
+        features_on=32,
+        word_sigma=0.75,
+        train_per_class=20,
+        val_n=500,
+        test_n=1000,
+        seed=seed,
+    )
+
+
 def fb15k_like(
     n_ent: int = 2000,
     n_rel: int = 40,

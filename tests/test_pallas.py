@@ -33,11 +33,14 @@ def test_interpret_matches_xla(data):
     np.testing.assert_allclose(out_i, out_x, rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("f", [64, 256, 512, 200])
+@pytest.mark.parametrize("f", [64, 256, 200])
 def test_wide_features_chunked_gather(rng, f):
-    """f > 128 rides the two-level 128-lane chunk gather; f=200 also
-    exercises the pad-to-lane-tile path."""
-    n_src, n_dst, d = 30, 11, 5
+    """f > 128 rides the two-level 128-lane chunk gather: 256 covers the
+    k=2 chunk loop (size-generic — wider k re-runs the same copies), 200
+    the pad-to-lane-tile path. Sizes are the minimum that still cover a
+    non-tile-aligned n_dst — interpret-mode DMA emulation costs ~0.15s
+    per copy, so row counts directly set the gate's wall clock."""
+    n_src, n_dst, d = 18, 6, 3
     x = jnp.asarray(rng.normal(size=(n_src, f)), jnp.float32)
     slots = jnp.asarray(rng.integers(0, n_src, size=(n_dst, d)), jnp.int32)
     w = jnp.asarray(rng.random((n_dst, d)), jnp.float32)
