@@ -304,7 +304,9 @@ _DATASET_GEN_V = 2  # bump when the synthetic generator changes, so cached
 # /tmp datasets from older generator code are never silently reused
 
 
-def _build_remote_dataset(num_nodes, out_degree, feat_dim, shards) -> str:
+def _build_remote_dataset(
+    num_nodes, out_degree, feat_dim, shards, weighted=False
+) -> str:
     """Materialize (once) a sharded on-disk graph for the remote bench."""
     import tempfile
 
@@ -314,7 +316,8 @@ def _build_remote_dataset(num_nodes, out_degree, feat_dim, shards) -> str:
     d = os.path.join(
         tempfile.gettempdir(),
         f"etpu_rbench_v{_DATASET_GEN_V}"
-        f"_{num_nodes}_{out_degree}_{feat_dim}_{shards}",
+        f"_{num_nodes}_{out_degree}_{feat_dim}_{shards}"
+        + ("_w" if weighted else ""),
     )
     if os.path.exists(os.path.join(d, "euler.meta.json")):
         return d
@@ -325,6 +328,7 @@ def _build_remote_dataset(num_nodes, out_degree, feat_dim, shards) -> str:
         feat_dim=feat_dim,
         num_partitions=shards,
         seed=0,
+        weighted=weighted,
     )
     # build in a temp dir and rename into place: a kill mid-build (driver
     # timeout / watchdog os._exit) must not leave a half-written dataset
@@ -405,7 +409,12 @@ def run_remote(platform: str) -> tuple[float, dict]:
         print(f"# remote[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr)
         sys.stderr.flush()
 
-    data = _build_remote_dataset(num_nodes, out_degree, feat_dim, shards)
+    # EULER_BENCH_WEIGHTED=1: non-unit edge weights → the weighted-lean
+    # wire (bf16 weights next to the rows) instead of the unit-lean wire
+    weighted = os.environ.get("EULER_BENCH_WEIGHTED", "0") == "1"
+    data = _build_remote_dataset(
+        num_nodes, out_degree, feat_dim, shards, weighted=weighted
+    )
     reg = tempfile.mkdtemp(prefix="etpu_rbench_reg_")
     global _REMOTE_PROCS
     procs = _REMOTE_PROCS = [
@@ -470,6 +479,7 @@ def run_remote(platform: str) -> tuple[float, dict]:
             "edges_total": num_nodes * out_degree,
             "steps_per_call": steps_per_call,
             "bf16": bool(bf16),
+            "weighted_lean": bool(weighted),
         }
         return value, extra
     finally:

@@ -37,6 +37,7 @@ def shard_arrays(
     num_partitions: int,
     rng: np.random.Generator,
     centers: np.ndarray | None = None,
+    weighted: bool = False,
 ) -> dict:
     """Columnar arrays for shard p of the random regular digraph.
 
@@ -60,6 +61,14 @@ def shard_arrays(
         centers = rng.spawn(1)[0].normal(0.0, 4.0, (label_dim, feat_dim))
     feat = centers[cluster] + rng.normal(0.0, 1.0, size=(n, feat_dim))
     label = np.eye(label_dim, dtype=np.float32)[cluster]
+    # weighted=True: non-unit edge weights in [0.5, 2.0) — exercises the
+    # weighted-lean wire and weighted alias sampling (a uniform-weight
+    # graph silently skips both)
+    ew = (
+        rng.uniform(0.5, 2.0, size=e).astype(np.float32)
+        if weighted
+        else np.ones(e, dtype=np.float32)
+    )
 
     arrays = {
         "node_ids": ids,
@@ -68,10 +77,10 @@ def shard_arrays(
         "edge_src": np.repeat(ids, out_degree),
         "edge_dst": dst,
         "edge_types": np.zeros(e, dtype=np.int32),
-        "edge_weights": np.ones(e, dtype=np.float32),
+        "edge_weights": ew,
         "adj_0_indptr": np.arange(0, e + 1, out_degree, dtype=np.int64),
         "adj_0_dst": dst,
-        "adj_0_w": np.ones(e, dtype=np.float32),
+        "adj_0_w": ew,
         "adj_0_eidx": np.arange(e, dtype=np.int64),
         "nf_dense_0": feat.astype(np.float32),
         "nf_dense_1": label,
@@ -91,7 +100,9 @@ def shard_arrays(
     np.add.at(indptr, rows + 1, 1)
     arrays["inadj_0_indptr"] = np.cumsum(indptr)
     arrays["inadj_0_dst"] = in_src[order]
-    arrays["inadj_0_w"] = np.ones(len(rows), dtype=np.float32)
+    arrays["inadj_0_w"] = ew[in_sel][ok][order] if weighted else np.ones(
+        len(rows), dtype=np.float32
+    )
     arrays["inadj_0_eidx"] = np.full(len(rows), -1, dtype=np.int64)
     return arrays
 
@@ -103,6 +114,7 @@ def random_graph(
     label_dim: int = 2,
     num_partitions: int = 1,
     seed: int = 0,
+    weighted: bool = False,
 ) -> Graph:
     """Uniform random regular digraph with cluster-separable features."""
     rng = np.random.default_rng(seed)
@@ -112,11 +124,12 @@ def random_graph(
     for p in range(num_partitions):
         arrays = shard_arrays(
             p, num_nodes, out_degree, feat_dim, label_dim, num_partitions,
-            rng, centers,
+            rng, centers, weighted=weighted,
         )
         n = len(arrays["node_ids"])
-        e = len(arrays["edge_dst"])
         meta.node_weight_sums.append([float(n)])
-        meta.edge_weight_sums.append([float(e)])
+        meta.edge_weight_sums.append(
+            [float(arrays["edge_weights"].sum())]
+        )
         shards.append(GraphStore(meta, arrays, part=p))
     return Graph(meta, shards)

@@ -148,6 +148,13 @@ class RangeIndex:
 
     def _coerce(self, value):
         """Search value → the index dtype; None = below an unsigned domain."""
+        if not isinstance(value, (int, float, str, np.integer, np.floating)):
+            # a list/tuple here means a malformed condition (e.g. a GQL
+            # in_() list reaching a scalar comparator) — reject it as a
+            # query error, not a raw float(list) TypeError
+            raise ValueError(
+                f"scalar comparison value expected, got {type(value).__name__}"
+            )
         dt = self._vals.dtype
         integral = isinstance(value, (int, np.integer)) or (
             isinstance(value, float) and value.is_integer()

@@ -267,6 +267,48 @@ def test_in_list_condition(g):
     assert kept == {1, 3}
 
 
+def test_parser_fuzz_no_crashes(graph1):
+    """Deterministic fuzz of the GQL front end (the reference ships no
+    parser fuzzing at all — SURVEY §4): random token soup, truncated
+    chains, unbalanced parens, and mutated valid queries must raise
+    SyntaxError/ValueError/KeyError, never anything else — and valid
+    prefixes must not corrupt later valid runs."""
+    import itertools
+
+    rng = np.random.default_rng(7)
+    tokens = [
+        "v", "e", "sampleN", "sampleNB", "outV", "values", "has", "as",
+        "limit", "order_by", "(", ")", ".", ",", "[", "]", "0", "1",
+        "3.5", "'x'", "dense2", "gt", "udf_mean", "not_a_step", "_", "!",
+        "∑", "\\", '"y"', "", " ",
+    ]
+    ok = bad = 0
+    for i in range(300):
+        n = int(rng.integers(1, 12))
+        src = "".join(rng.choice(tokens) for _ in range(n))
+        try:
+            Query(src).run(graph1, {"roots": np.asarray([1], np.uint64)})
+            ok += 1
+        except (SyntaxError, ValueError, KeyError):
+            bad += 1
+        # any other exception type propagates and fails the test
+    assert bad > 200  # the soup is overwhelmingly invalid, and safely so
+
+    # mutations of a valid chain: drop/duplicate one character
+    base = "v(roots).has(dense2, gt(3)).values(dense3).as(x)"
+    for k in itertools.chain(range(0, len(base), 3), [len(base) - 1]):
+        for mut in (base[:k] + base[k + 1:], base[:k] + base[k] + base[k:]):
+            try:
+                Query(mut).run(
+                    graph1, {"roots": np.asarray([1], np.uint64)}
+                )
+            except (SyntaxError, ValueError, KeyError):
+                pass
+    # the parser/compiler state survives the abuse: a valid query runs
+    res = run_gql(graph1, base, {"roots": np.asarray([1, 2], np.uint64)})
+    assert res["x"].shape[0] == 2
+
+
 def test_limit_after_out_e_keeps_triples(g):
     res = run_gql(g, "v([1, 2, 3]).outE().limit(2).as(e)")
     triples, w, mask = res["e"]

@@ -281,8 +281,6 @@ def test_gcn_pubmed_f1(tmp_path):
     classes, 500-dim) reproduces the published pubmed pair — LR 0.720
     (pubmed ~0.72) and GCN 0.882 (published 0.871) — so the calibration
     methodology isn't a one-dataset artifact."""
-    import jax
-
     from euler_tpu.datasets.quality import pubmed_like_json
 
     j = pubmed_like_json()
@@ -314,8 +312,6 @@ def test_gcn_citeseer_f1(tmp_path):
     published citeseer pair — LR 0.592 (citeseer ~0.60) and GCN 0.744
     (published 0.752) — so the calibration methodology reproduces all
     three published columns (cora / pubmed / citeseer)."""
-    import jax
-
     from euler_tpu.datasets.quality import citeseer_like_json
 
     j = citeseer_like_json()
