@@ -12,6 +12,7 @@ base_estimator.py:157-179.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 import time
 from typing import Callable, Iterable, Iterator
@@ -132,7 +133,14 @@ class Estimator:
         import flax.linen as nn
 
         if self._init_params is not None and self.mesh is None:
-            self.params = self._init_params
+            # COPY the warm-start arrays: the donated train step would
+            # otherwise invalidate the caller's buffers on TPU (e.g. a
+            # trained TransE whose tables seed TransR via
+            # transx_warm_start) — buffer donation is a no-op on CPU, so
+            # only real-device runs would hit the corruption
+            self.params = jax.tree_util.tree_map(
+                lambda x: jnp.array(x, copy=True), self._init_params
+            )
             self.opt_state = self.tx.init(self.params)
             return
         batch = self._put(
@@ -154,10 +162,14 @@ class Estimator:
                 # warm-start under a mesh: the cold init above provides
                 # the placement template (row-sharded tables etc.); the
                 # warm values are device_put onto the same shardings so
-                # model parallelism survives the warm start
+                # model parallelism survives the warm start. copy=True is
+                # load-bearing: device_put aliases a src that already has
+                # the target sharding, and the donated train step would
+                # then delete the CALLER's buffers (the donor model's
+                # params) on real devices
                 params = jax.tree_util.tree_map(
                     lambda tgt, src: jax.device_put(
-                        jnp.asarray(src), tgt.sharding
+                        jnp.array(src, copy=True), tgt.sharding
                     ),
                     params,
                     self._init_params,
@@ -176,7 +188,10 @@ class Estimator:
     def _train_step(self):
         if self._jit_train is None:
 
-            @jax.jit
+            # donate params+opt_state: without it the update keeps both
+            # old and new buffers alive across the step — 2x the HBM for
+            # model state (the big cost for sharded embedding tables)
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
             def train_step(params, opt_state, rngs, *batch):
                 batch = self._hydrate(batch)
 
@@ -200,7 +215,7 @@ class Estimator:
         """K optimizer steps per dispatch via lax.scan over stacked batches."""
         if self._jit_train_scan is None:
 
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
             def multi_step(params, opt_state, rngs, *stacked_batch):
                 def body(carry, xs):
                     params, opt_state = carry

@@ -77,6 +77,10 @@ def build_parser():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--data-parallel", type=int, default=0,
                     help="devices for a data-parallel mesh (0 = single)")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize conv layers on backward "
+                         "(jax.checkpoint) — trades FLOPs for HBM on "
+                         "deep stacks / wide fanouts")
     return ap
 
 
@@ -120,6 +124,14 @@ def main(argv=None):
 
     name = args.model
     feature = "feature"
+    if args.remat and (
+        name in KG_MODELS
+        or name in ("deepwalk", "node2vec", "line", "fastgcn",
+                    "adaptivegcn", "rgcn", "scalable_gcn", "scalable_sage")
+    ):
+        # embedding-table and dense-layerwise families have no conv stack
+        # to rematerialize — say so instead of silently ignoring the flag
+        print(f"# --remat has no effect for model {name!r} (no conv stack)")
     label_dim = getattr(ds, "num_classes", 2) if ds else 2
     dims = [args.hidden_dim] * args.layers
     flow = None  # set by families that evaluate/infer through a dataflow
@@ -164,6 +176,7 @@ def main(argv=None):
         model = GraphClassifier(
             conv=conv, dims=tuple(dims),
             num_classes=max(flow.num_classes, 2), pool=pool,
+            remat=args.remat,
         )
         est = Estimator(
             model, graph_label_batches(graph, flow, args.batch_size, rng=rng),
@@ -204,7 +217,9 @@ def main(argv=None):
         from euler_tpu.models import GAE, gae_batches
 
         flow = SageDataFlow(graph, [feature], fanouts=args.fanouts[:1], rng=rng)
-        model = GAE(dims=dims[:1], variational=(name == "vgae"))
+        model = GAE(
+            dims=dims[:1], variational=(name == "vgae"), remat=args.remat
+        )
         est = Estimator(
             model, gae_batches(graph, flow, args.batch_size, rng=rng), cfg,
             mesh=mesh,
@@ -214,7 +229,7 @@ def main(argv=None):
         from euler_tpu.models import DGI, dgi_batches
 
         flow = SageDataFlow(graph, [feature], fanouts=args.fanouts[:1], rng=rng)
-        model = DGI(dims=dims[:1])
+        model = DGI(dims=dims[:1], remat=args.remat)
         est = Estimator(
             model, dgi_batches(graph, flow, args.batch_size, rng=rng), cfg,
             mesh=mesh,
@@ -237,7 +252,7 @@ def main(argv=None):
         from euler_tpu.models import GraphSAGEUnsupervised
 
         flow = SageDataFlow(graph, [feature], fanouts=args.fanouts[: args.layers], rng=rng)
-        model = GraphSAGEUnsupervised(dims=dims)
+        model = GraphSAGEUnsupervised(dims=dims, remat=args.remat)
         est = Estimator(
             model,
             unsupervised_batches(
@@ -259,7 +274,7 @@ def main(argv=None):
         conv_kwargs = {"improved": True} if CONV_MODELS[name] == "gat" else None
         model = SuperviseModel(
             conv=CONV_MODELS[name], dims=dims, label_dim=label_dim,
-            conv_kwargs=conv_kwargs,
+            conv_kwargs=conv_kwargs, remat=args.remat,
         )
         est = Estimator(
             model, node_batches(graph, flow, args.batch_size, 0, rng=rng),

@@ -26,11 +26,12 @@ class GAE(nn.Module):
     dims: Sequence[int]
     variational: bool = False
     kl_weight: float = 1e-2
+    remat: bool = False  # rematerialize conv layers (GNNNet.remat)
 
     rng_collections = ("reparam",)  # consumed by Estimator
 
     def setup(self):
-        self.encoder = GNNNet(conv="gcn", dims=self.dims)
+        self.encoder = GNNNet(conv="gcn", dims=self.dims, remat=self.remat)
         if self.variational:
             self.mu_head = nn.Dense(self.dims[-1])
             self.logvar_head = nn.Dense(self.dims[-1])
@@ -77,9 +78,10 @@ class DGI(nn.Module):
     readout through a bilinear discriminator (examples/dgi)."""
 
     dims: Sequence[int]
+    remat: bool = False  # rematerialize conv layers (GNNNet.remat)
 
     def setup(self):
-        self.encoder = GNNNet(conv="gcn", dims=self.dims)
+        self.encoder = GNNNet(conv="gcn", dims=self.dims, remat=self.remat)
         d = self.dims[-1]
         self.bilinear = self.param(
             "bilinear", nn.initializers.lecun_normal(), (d, d)

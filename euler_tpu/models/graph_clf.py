@@ -22,9 +22,12 @@ class GraphClassifier(nn.Module):
     num_classes: int = 2
     pool: str = "mean"  # add | mean | max | attention | set2set
     activation: str = "relu"
+    remat: bool = False  # rematerialize conv layers (see GNNNet.remat)
 
     def setup(self):
         cls = get_conv(self.conv)
+        if self.remat:
+            cls = nn.remat(cls, static_argnums=())
         self.convs = [cls(out_dim=d) for d in self.dims]
         if self.pool == "attention":
             self.pooler = AttentionPool()

@@ -29,6 +29,7 @@ class _EncodedGNN(nn.Module):
     encoder_dim: int = 0  # 0 → raw features
     max_id: int = 0
     conv_kwargs: dict | None = None
+    remat: bool = False  # rematerialize conv layers (GNNNet.remat)
 
     def setup(self):
         if self.encoder_dim:
@@ -36,7 +37,8 @@ class _EncodedGNN(nn.Module):
                 dim=self.encoder_dim, max_id=self.max_id
             )
         self.gnn = GNNNet(
-            conv=self.conv, dims=self.dims, conv_kwargs=self.conv_kwargs
+            conv=self.conv, dims=self.dims, conv_kwargs=self.conv_kwargs,
+            remat=self.remat,
         )
 
     def __call__(self, batch: MiniBatch) -> jnp.ndarray:
@@ -59,6 +61,7 @@ class GraphSAGESupervised(nn.Module):
     max_id: int = 0
     conv: str = "sage"
     conv_kwargs: dict | None = None
+    remat: bool = False
 
     def setup(self):
         self.net = _EncodedGNN(
@@ -67,6 +70,7 @@ class GraphSAGESupervised(nn.Module):
             encoder_dim=self.encoder_dim,
             max_id=self.max_id,
             conv_kwargs=self.conv_kwargs,
+            remat=self.remat,
         )
         self.out = nn.Dense(self.label_dim)
 
@@ -87,6 +91,7 @@ class GraphSAGEUnsupervised(nn.Module):
     max_id: int = 0
     conv: str = "sage"
     conv_kwargs: dict | None = None
+    remat: bool = False
 
     def setup(self):
         self.net = _EncodedGNN(
@@ -95,6 +100,7 @@ class GraphSAGEUnsupervised(nn.Module):
             encoder_dim=self.encoder_dim,
             max_id=self.max_id,
             conv_kwargs=self.conv_kwargs,
+            remat=self.remat,
         )
 
     def embed(self, batch: MiniBatch) -> jnp.ndarray:

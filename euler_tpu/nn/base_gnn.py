@@ -23,15 +23,24 @@ class GNNNet(nn.Module):
 
     conv: layer name from euler_tpu.layers.CONVS
     dims: output dim per layer; len(dims) must equal len(batch.blocks)
+    remat: rematerialize each layer's forward on the backward pass
+      (jax.checkpoint / nn.remat) — a fanout batch's activations scale as
+      Σ_l B·Πk_i·F per layer, which dominates HBM for deep stacks or wide
+      fanouts; remat trades one extra forward FLOP pass for dropping them,
+      the standard TPU memory lever. Numerics are identical (asserted in
+      tests/test_training.py).
     """
 
     conv: str
     dims: Sequence[int]
     activation: str = "relu"
     conv_kwargs: dict | None = None
+    remat: bool = False
 
     def setup(self):
         cls = get_conv(self.conv)
+        if self.remat:
+            cls = nn.remat(cls, static_argnums=())
         kwargs = dict(self.conv_kwargs or {})
         self.convs = [cls(out_dim=d, **kwargs) for d in self.dims]
 

@@ -25,10 +25,12 @@ class SuperviseModel(nn.Module):
     dims: Sequence[int]
     label_dim: int
     conv_kwargs: dict | None = None
+    remat: bool = False  # rematerialize conv layers (GNNNet.remat)
 
     def setup(self):
         self.gnn = GNNNet(
-            conv=self.conv, dims=self.dims, conv_kwargs=self.conv_kwargs
+            conv=self.conv, dims=self.dims, conv_kwargs=self.conv_kwargs,
+            remat=self.remat,
         )
         self.out = nn.Dense(self.label_dim)
 
@@ -54,10 +56,12 @@ class UnsuperviseModel(nn.Module):
     dims: Sequence[int]
     conv_kwargs: dict | None = None
     temperature: float = 1.0
+    remat: bool = False  # rematerialize conv layers (GNNNet.remat)
 
     def setup(self):
         self.gnn = GNNNet(
-            conv=self.conv, dims=self.dims, conv_kwargs=self.conv_kwargs
+            conv=self.conv, dims=self.dims, conv_kwargs=self.conv_kwargs,
+            remat=self.remat,
         )
 
     def embed(self, batch: MiniBatch) -> jnp.ndarray:
