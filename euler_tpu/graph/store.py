@@ -96,6 +96,51 @@ def lean_feats(hop_rows) -> np.ndarray:
     )
 
 
+def layerwise_from_full(nbr, w, mask, count: int, rng) -> tuple:
+    """LADIES-style layer selection from a batch's full neighbor arrays.
+
+    Candidates are weighted ∝ their TOTAL incident weight from the batch,
+    sampled WITHOUT replacement via Gumbel top-k (with-replacement +
+    unique would concentrate on the few heaviest candidates and shrink
+    the effective layer far below `count`); when the whole frontier fits
+    in `count` the layer is EXACT. Shared by GraphStore and the
+    partitioned facade — the facade scatter-gathers get_full_neighbor
+    first, so a candidate whose incident weight is split across shards
+    is weighted by the true global sum (per-shard sampling + union would
+    bias toward shard order).
+
+    Returns (layer_ids u64[count], adj f32[n, count], mask bool[count]).
+    """
+    n = nbr.shape[0]
+    flat_ids = nbr[mask]
+    flat_w = w[mask].astype(np.float64)
+    if len(flat_ids) == 0:
+        return (
+            np.full(count, DEFAULT_ID, dtype=np.uint64),
+            np.zeros((n, count), dtype=np.float32),
+            np.zeros(count, dtype=bool),
+        )
+    uniq, inv = np.unique(flat_ids, return_inverse=True)
+    wsum = np.zeros(len(uniq))
+    np.add.at(wsum, inv, flat_w)
+    if len(uniq) <= count:
+        chosen = np.arange(len(uniq))
+    else:
+        keys = np.log(np.maximum(wsum, 1e-30)) + rng.gumbel(size=len(uniq))
+        chosen = np.sort(np.argpartition(-keys, count - 1)[:count])
+    layer = np.full(count, DEFAULT_ID, dtype=np.uint64)
+    layer[: len(chosen)] = uniq[chosen]
+    lmask = layer != DEFAULT_ID
+    # batch → layer adjacency
+    pos = np.searchsorted(uniq[chosen], nbr.ravel())
+    pos = np.clip(pos, 0, len(chosen) - 1)
+    hit = mask.ravel() & (uniq[chosen][pos] == nbr.ravel())
+    adj = np.zeros((n, count), dtype=np.float32)
+    rr = np.repeat(np.arange(n), nbr.shape[1])
+    np.add.at(adj, (rr[hit], pos[hit]), w.ravel()[hit])
+    return layer, adj, lmask
+
+
 def multi_hop_neighbor(graph, nodes, edge_types_per_hop):
     """Hop-by-hop unioned receptive field with inter-hop adjacency
     (get_multi_hop_neighbor parity,
@@ -566,41 +611,7 @@ class GraphStore:
         rng = _rng(rng)
         batch_ids = np.asarray(batch_ids, dtype=np.uint64)
         nbr, w, _, mask, _ = self.get_full_neighbor(batch_ids, edge_types)
-        flat_ids = nbr[mask]
-        flat_w = w[mask].astype(np.float64)
-        if len(flat_ids) == 0:
-            return (
-                np.full(count, DEFAULT_ID, dtype=np.uint64),
-                np.zeros((len(batch_ids), count), dtype=np.float32),
-                np.zeros(count, dtype=bool),
-            )
-        uniq, inv = np.unique(flat_ids, return_inverse=True)
-        wsum = np.zeros(len(uniq))
-        np.add.at(wsum, inv, flat_w)
-        if len(uniq) <= count:
-            # frontier fits: take every neighbor — the layer is EXACT
-            # (eval batches sized under `count` get GCN-quality inference)
-            chosen = np.arange(len(uniq))
-        else:
-            # weighted sampling WITHOUT replacement (Gumbel top-k):
-            # sampling with replacement + unique would concentrate on the
-            # few heaviest candidates and shrink the effective layer far
-            # below `count`, starving aggregation coverage
-            keys = np.log(np.maximum(wsum, 1e-30)) + rng.gumbel(
-                size=len(uniq)
-            )
-            chosen = np.sort(np.argpartition(-keys, count - 1)[:count])
-        layer = np.full(count, DEFAULT_ID, dtype=np.uint64)
-        layer[: len(chosen)] = uniq[chosen]
-        lmask = layer != DEFAULT_ID
-        # batch → layer adjacency
-        pos = np.searchsorted(uniq[chosen], nbr.ravel())
-        pos = np.clip(pos, 0, len(chosen) - 1)
-        hit = mask.ravel() & (uniq[chosen][pos] == nbr.ravel())
-        adj = np.zeros((len(batch_ids), count), dtype=np.float32)
-        rr = np.repeat(np.arange(len(batch_ids)), nbr.shape[1])
-        np.add.at(adj, (rr[hit], pos[hit]), w.ravel()[hit])
-        return layer, adj, lmask
+        return layerwise_from_full(nbr, w, mask, count, rng)
 
     # ---- features (node.h:120-145 / feature_ops parity) ----------------
 
@@ -1452,30 +1463,21 @@ class Graph:
         return out
 
     def sample_neighbor_layerwise(self, batch_ids, edge_types=None, count=128, rng=None):
-        """Single-shard path for now; multi-shard merges candidate sets."""
+        """Exact on any shard count: scatter-gather the batch's full
+        neighbor arrays (each node's out-adjacency lives whole on its
+        owner shard), then run the ONE candidate selection over the
+        merged result — a candidate cited by batch nodes on different
+        shards is weighted by its true global incident sum. (The earlier
+        per-shard sample + truncating union kept shard 0's candidates
+        preferentially and split candidate weights.)"""
         rng = _rng(rng)
         if self.num_shards == 1:
             return self.shards[0].sample_neighbor_layerwise(
                 batch_ids, edge_types, count, rng
             )
-        per = -(-count // self.num_shards)  # ceil: keep the [count] contract
-        layers, adjs, masks = [], [], []
-        for sh in self.shards:
-            l, a, m = sh.sample_neighbor_layerwise(batch_ids, edge_types, per, rng)
-            layers.append(l)
-            adjs.append(a)
-            masks.append(m)
-        layer = np.concatenate(layers)[:count]
-        adj = np.concatenate(adjs, axis=1)[:, :count]
-        mask = np.concatenate(masks)[:count]
-        if len(layer) < count:  # pad back up if shards under-filled
-            pad = count - len(layer)
-            layer = np.concatenate(
-                [layer, np.full(pad, DEFAULT_ID, dtype=np.uint64)]
-            )
-            adj = np.pad(adj, ((0, 0), (0, pad)))
-            mask = np.concatenate([mask, np.zeros(pad, dtype=bool)])
-        return layer, adj, mask
+        batch_ids = np.asarray(batch_ids, dtype=np.uint64)
+        nbr, w, _, mask, _ = self.get_full_neighbor(batch_ids, edge_types)
+        return layerwise_from_full(nbr, w, mask, count, rng)
 
     def get_dense_feature(self, ids, names) -> np.ndarray:
         return self._scatter_gather(ids, lambda sh, i: sh.get_dense_feature(i, names))

@@ -98,3 +98,21 @@ def test_extra_convs(g, conv):
     out = layer.apply(params, mb.feats[0], mb.feats[1], mb.blocks[0])
     assert out.shape == (4, 8)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_layerwise_multishard_matches_single(graph1, graph2):
+    """The partitioned facade's layer sampling is EXACT: same rng seed →
+    identical candidate layer and adjacency as the single-shard store,
+    because both run one global Gumbel-top-k over the merged frontier
+    (candidates whose incident weight splits across shards get their true
+    global sum — the old per-shard union biased toward shard 0)."""
+    ids = np.asarray([1, 2, 3, 4], np.uint64)
+    l1, a1, m1 = graph1.sample_neighbor_layerwise(
+        ids, None, count=3, rng=np.random.default_rng(5)
+    )
+    l2, a2, m2 = graph2.sample_neighbor_layerwise(
+        ids, None, count=3, rng=np.random.default_rng(5)
+    )
+    np.testing.assert_array_equal(l1, l2)
+    np.testing.assert_allclose(a1, a2, rtol=1e-6)
+    np.testing.assert_array_equal(m1, m2)
