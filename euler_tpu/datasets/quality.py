@@ -50,6 +50,117 @@ def pubmed_like_json(seed: int = 0) -> dict:
     )
 
 
+def products_like_graph(
+    num_nodes: int = 50_000,
+    num_classes: int = 47,
+    feature_dim: int = 100,
+    avg_degree: int = 16,
+    homophily: float = 0.57,
+    noise: float = 3.45,
+    train_frac: float = 0.08,
+    val_frac: float = 0.02,
+    seed: int = 0,
+):
+    """ogbn-products-shaped stand-in for the NORTH-STAR quality config
+    (BASELINE.json: GraphSAGE node-classification on ogbn-products).
+
+    ogbn-products itself (2.45M nodes / 61.9M edges / PCA-100 features /
+    47 classes, sales-rank split 8%/2%/90%) cannot be downloaded here;
+    this plants the same learning problem at 1/50 scale: skewed class
+    sizes (Zipf-like, as product categories are), 100-dim Gaussian
+    class-center features whose `noise` is tuned so a feature-only
+    model lands at the published MLP baseline (0.6106 accuracy), and
+    homophilous co-purchase edges tuned so sampled-fanout GraphSAGE
+    lands at the published leaderboard score (0.7849 ± 0.004). Measured
+    at the defaults (seed 0): feature-only LR 0.6180, SAGE [10,5]
+    fanout 0.7780 — both within a point of the published pair.
+    Generation is fully vectorized/columnar (≈1M edge triples — a
+    per-edge json dict would dominate runtime).
+
+    Returns (Graph, types int64[N]) with types 0/1/2 = train/val/test.
+    """
+    from euler_tpu.graph import Graph
+    from euler_tpu.graph.store import GraphStore
+
+    rng = np.random.default_rng(seed)
+    # Zipf-ish class masses like product categories
+    mass = 1.0 / np.arange(1, num_classes + 1) ** 0.7
+    mass /= mass.sum()
+    classes = rng.choice(num_classes, size=num_nodes, p=mass)
+    by_class = [np.nonzero(classes == c)[0] for c in range(num_classes)]
+
+    # heavy-tailed out-degrees, co-purchase style
+    deg = np.clip(
+        rng.lognormal(np.log(avg_degree * 0.7), 0.8, num_nodes), 2, 120
+    ).astype(np.int64)
+    e = int(deg.sum())
+    src = np.repeat(np.arange(num_nodes), deg)
+    same = rng.random(e) < homophily
+    # homophilous endpoints: uniform within the src's class (vectorized
+    # via per-class cumulative pools), drawn only where needed
+    pool_offsets = np.r_[0, np.cumsum([len(p) for p in by_class])]
+    pools = np.concatenate(by_class)
+    dst = rng.integers(0, num_nodes, e)
+    cls_of_src = classes[src[same]]
+    lo = pool_offsets[cls_of_src]
+    hi = pool_offsets[cls_of_src + 1]
+    dst[same] = pools[
+        lo + (rng.random(int(same.sum())) * (hi - lo)).astype(np.int64)
+    ]
+
+    centers = rng.normal(0.0, 1.0, (num_classes, feature_dim))
+    feat = centers[classes] + noise * rng.normal(
+        0.0, 1.0, (num_nodes, feature_dim)
+    )
+    labels = np.zeros((num_nodes, num_classes), np.float32)
+    labels[np.arange(num_nodes), classes] = 1.0
+
+    types = np.full(num_nodes, 2, np.int64)
+    perm = rng.permutation(num_nodes)
+    n_tr = int(train_frac * num_nodes)
+    n_val = int(val_frac * num_nodes)
+    types[perm[:n_tr]] = 0
+    types[perm[n_tr : n_tr + n_val]] = 1
+
+    ids = np.arange(1, num_nodes + 1, dtype=np.uint64)
+    # src is sorted by construction (repeat of arange): CSR directly
+    src_s, dst_s = src, dst
+    indptr = np.r_[0, np.cumsum(deg)]
+    from euler_tpu.graph.meta import FeatureSpec, GraphMeta
+
+    meta = GraphMeta(
+        num_node_types=3,
+        num_edge_types=1,
+        node_features={
+            "feature": FeatureSpec("feature", "dense", 0, feature_dim),
+            "label": FeatureSpec("label", "dense", 1, num_classes),
+        },
+        edge_features={},
+        num_partitions=1,
+    )
+    meta.node_weight_sums = [[float((types == t).sum()) for t in range(3)]]
+    meta.edge_weight_sums = [[float(e)]]
+    arrays = {
+        "node_ids": ids,
+        "node_types": types.astype(np.int32),
+        "node_weights": np.ones(num_nodes, np.float32),
+        "edge_src": ids[src_s],
+        "edge_dst": ids[dst_s],
+        "edge_types": np.zeros(e, np.int32),
+        "edge_weights": np.ones(e, np.float32),
+        "adj_0_indptr": indptr,
+        "adj_0_dst": ids[dst_s],
+        "adj_0_w": np.ones(e, np.float32),
+        "adj_0_eidx": np.arange(e, dtype=np.int64),
+        "nf_dense_0": feat.astype(np.float32),
+        "nf_dense_1": labels,
+        "glabel_indptr": np.zeros(1, np.int64),
+        "glabel_nodes": np.zeros(0, np.uint64),
+    }
+    store = GraphStore(meta, arrays, part=0)
+    return Graph(meta, [store]), types
+
+
 def citeseer_like_json(seed: int = 0) -> dict:
     """Citeseer-shaped stand-in: 3327 nodes, 6 classes, 3703-dim sparse
     features, sparse citation graph (avg degree 2.8), 20-per-class split.

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement suite — run whenever a working chip is
+# available (the round-4 build window had the tunnel down throughout;
+# this captures every chip-gated measurement in priority order).
+#
+#   bash euler_tpu/tools/tpu_suite.sh [outdir]
+#
+# 1. Headline bench (local + remote legs) → bench.json
+# 2. KG-family throughput (TransE/H/R/D vs the published OpenKE table)
+#    → kg_bench.json
+# 3. Wide-F Pallas end-to-end A/B (dims 256: EULER_TPU_PALLAS=off vs
+#    =pallas, local leg only) → widef_off.json / widef_pallas.json
+#    — if pallas wins, raise _PALLAS_AUTO_MAX_F (ops/pallas_kernels.py)
+#    and record the row in ops/PALLAS_BENCH.md.
+set -u
+cd "$(dirname "$0")/../.."
+OUT="${1:-/tmp/etpu_tpu_suite}"
+mkdir -p "$OUT"
+
+probe=$(timeout 120 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+echo "# platform probe: ${probe:-unreachable}"
+if [ "${probe:-}" != "tpu" ] && [ "${probe:-}" != "axon" ]; then
+  echo "# no chip — nothing measured" && exit 1
+fi
+
+echo "# 1/3 headline bench"
+timeout 1200 python bench.py | tee "$OUT/bench.json"
+
+echo "# 2/3 KG throughput"
+timeout 900 python -m euler_tpu.tools.kg_bench | tee "$OUT/kg_bench.json"
+
+echo "# 3/3 wide-F Pallas A/B (dims 256)"
+EULER_BENCH_REMOTE=0 EULER_BENCH_DIMS=256,256 EULER_TPU_PALLAS=off \
+  timeout 900 python bench.py | tee "$OUT/widef_off.json"
+EULER_BENCH_REMOTE=0 EULER_BENCH_DIMS=256,256 EULER_TPU_PALLAS=pallas \
+  timeout 900 python bench.py | tee "$OUT/widef_pallas.json"
+
+echo "# done → $OUT"
