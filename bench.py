@@ -44,9 +44,13 @@ BF16 = "--bf16" in sys.argv
 CPU_FALLBACK = "--_cpu-fallback" in sys.argv
 BASELINE_EDGES_PER_SEC = 2_000_000.0
 
-PROBE_TIMEOUT_S = float(os.environ.get("EULER_BENCH_PROBE_TIMEOUT", 240.0))
-PROBE_ATTEMPTS = int(os.environ.get("EULER_BENCH_PROBE_ATTEMPTS", 3))
-PROBE_SLEEP_S = (10.0, 20.0, 0.0)
+# a healthy tunnel initializes in ~2s; a broken one hangs forever (the
+# whole round-4 window measured exactly these two modes). Keep the
+# worst-case probe budget well under any plausible external timeout so
+# the CPU fallback still emits its lines: 2 x 150s + 5s ≈ 5 min.
+PROBE_TIMEOUT_S = float(os.environ.get("EULER_BENCH_PROBE_TIMEOUT", 150.0))
+PROBE_ATTEMPTS = int(os.environ.get("EULER_BENCH_PROBE_ATTEMPTS", 2))
+PROBE_SLEEP_S = (5.0, 0.0)
 # internal wall-clock budget for the remote leg (VERDICT r3 #1): the remote
 # leg must never be the reason the artifact is empty. A watchdog thread
 # force-emits partial results and exits the process if this expires —

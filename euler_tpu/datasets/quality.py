@@ -88,6 +88,13 @@ def products_like_graph(
     mass /= mass.sum()
     classes = rng.choice(num_classes, size=num_nodes, p=mass)
     by_class = [np.nonzero(classes == c)[0] for c in range(num_classes)]
+    if min(len(p_) for p_ in by_class) == 0:
+        # an empty class would make the homophilous index below collapse
+        # into the NEXT class's pool (or run off the end) — refuse loudly
+        raise ValueError(
+            "products_like_graph: a class drew zero members; increase "
+            "num_nodes or decrease num_classes"
+        )
 
     # heavy-tailed out-degrees, co-purchase style
     deg = np.clip(

@@ -30,9 +30,11 @@ echo "# 2/3 KG throughput"
 timeout 900 python -m euler_tpu.tools.kg_bench | tee "$OUT/kg_bench.json"
 
 echo "# 3/3 wide-F Pallas A/B (dims 256)"
-EULER_BENCH_REMOTE=0 EULER_BENCH_DIMS=256,256 EULER_TPU_PALLAS=off \
+EULER_BENCH_REMOTE=0 EULER_BENCH_FEAT_DIM=256 EULER_BENCH_DIMS=256,256 \
+  EULER_TPU_PALLAS=off \
   timeout 900 python bench.py | tee "$OUT/widef_off.json"
-EULER_BENCH_REMOTE=0 EULER_BENCH_DIMS=256,256 EULER_TPU_PALLAS=pallas \
+EULER_BENCH_REMOTE=0 EULER_BENCH_FEAT_DIM=256 EULER_BENCH_DIMS=256,256 \
+  EULER_TPU_PALLAS=pallas \
   timeout 900 python bench.py | tee "$OUT/widef_pallas.json"
 
 echo "# done → $OUT"
