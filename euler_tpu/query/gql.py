@@ -29,7 +29,9 @@ map to (ids, weights, types, mask); values aliases to feature arrays.
 
 from __future__ import annotations
 
+import functools
 import re
+import threading
 
 import numpy as np
 
@@ -115,7 +117,7 @@ def dense_feature_udf(graph, ids, names, udfs):
     names = list(names)
     widths = [graph.meta.feature_spec(nm, node=True).dim for nm in names]
     flat = graph.get_dense_feature(ids, names)
-    offs = np.r_[0, np.cumsum(widths)]
+    offs = _offsets(widths)
     cols = [
         apply_udf(udf, flat[:, offs[k] : offs[k + 1]])
         for k, udf in enumerate(udfs)
@@ -258,19 +260,69 @@ def _compile(calls):
     return steps
 
 
+_RNG_TLS = threading.local()
+
+
+def _default_rng():
+    """Per-thread fallback Generator — constructing a fresh default_rng
+    costs ~40us (OS entropy), which would dominate hot-loop dispatch, and
+    numpy Generators are not thread-safe so the cache is thread-local
+    (queries run on prefetch producer threads, estimator/prefetch.py)."""
+    rng = getattr(_RNG_TLS, "rng", None)
+    if rng is None:
+        rng = _RNG_TLS.rng = np.random.default_rng()
+    return rng
+
+
+def _offsets(widths):
+    """[w0, w1, ...] → [0, w0, w0+w1, ...] without numpy (np.r_ costs ~19us)."""
+    offs = [0]
+    for w in widths:
+        offs.append(offs[-1] + int(w))
+    return offs
+
+
+@functools.lru_cache(maxsize=512)
+def _compile_cached(gql: str):
+    """Query-string → (steps, plans), cached across Query instances
+    (reference caches GQL→DAG per query string, compiler.h:112-126).
+
+    `plans[i]` holds the static half of step i's work: for `values` steps
+    the resolved feature-name tuple and (position, udf_name) pairs, so the
+    hot loop does zero per-call arg introspection."""
+    steps = _compile(_parse(gql))
+    if not steps:
+        raise SyntaxError("empty query")
+    plans = []
+    for fn, args, _conds in steps:
+        if fn == "values":
+            names = tuple(
+                str(a[2][0]) if isinstance(a, tuple) else str(a)
+                for a in args
+            )
+            udf_pairs = tuple(
+                (k, a[1]) for k, a in enumerate(args)
+                if isinstance(a, tuple) and a[0] == "()"
+            )
+            plans.append((names, udf_pairs))
+        else:
+            plans.append(None)
+    return tuple(steps), tuple(plans)
+
+
 class Query:
-    """Compiled GQL chain; compile once, run per batch (Compiler cache
-    parity, compiler.h:112-126)."""
+    """Compiled GQL chain; compile once per unique string, run per batch
+    (Compiler cache parity, compiler.h:112-126)."""
 
     def __init__(self, gql: str):
         self.gql = gql
-        self.steps = _compile(_parse(gql))
-        if not self.steps:
-            raise SyntaxError("empty query")
+        steps, plans = _compile_cached(gql)
+        self.steps = list(steps)
+        self._plans = plans
 
     def run(self, graph, inputs: dict | None = None, rng=None) -> dict:
         inputs = inputs or {}
-        rng = rng if rng is not None else np.random.default_rng()
+        rng = rng if rng is not None else _default_rng()
         cur: np.ndarray | None = None  # current node frontier (u64)
         cur_edges: np.ndarray | None = None  # [n,3] edge frontier after e/outE
         last: object = None  # last step's full result
@@ -299,7 +351,7 @@ class Query:
             keep = graph.condition_mask(ids, resolve_dnf(conds))
             return np.where(keep, ids, DEFAULT_ID)
 
-        for fn, args, conds in self.steps:
+        for (fn, args, conds), plan in zip(self.steps, self._plans):
             if fn == "v":
                 cur_edges = None
                 cur = resolve_ids(args[0])
@@ -410,16 +462,18 @@ class Query:
                 # splice/aggregate per-arg columns in order; after an edge
                 # step (e/sampleE/outE) this reads EDGE features, matching
                 # the reference's get_feature kernel accepting edge_ids
-                names = [
-                    str(a[2][0]) if isinstance(a, tuple) else str(a)
-                    for a in args
-                ]
-                if names:
+                names, udf_pairs = plan
+                if names and not udf_pairs:
+                    # fast path: the per-arg column slices concatenated in
+                    # order ARE the batched fetch — return it untouched
+                    last = (
+                        graph.get_edge_dense_feature(cur_edges, list(names))
+                        if cur_edges is not None
+                        else graph.get_dense_feature(cur, list(names))
+                    )
+                elif names:
                     on_edges = cur_edges is not None
-                    udf_idx = [
-                        k for k, a in enumerate(args)
-                        if isinstance(a, tuple) and a[0] == "()"
-                    ]
+                    udf_idx = [k for k, _ in udf_pairs]
                     pushdown = getattr(graph, "get_dense_feature_udf", None)
                     udf_cols = None
                     if udf_idx and not on_edges and pushdown is not None:
@@ -451,7 +505,7 @@ class Query:
                             # split the concatenated aggregate back into
                             # per-arg columns by the reported widths (a
                             # UDF may return k>1 columns)
-                            ao = np.r_[0, np.cumsum(agg_w)]
+                            ao = _offsets(agg_w)
                             udf_cols = [
                                 agg[:, ao[i] : ao[i + 1]]
                                 for i in range(len(udf_idx))
@@ -477,7 +531,7 @@ class Query:
                             if on_edges
                             else graph.get_dense_feature(cur, fetch_names)
                         )
-                        offs = np.r_[0, np.cumsum(widths)]
+                        offs = _offsets(widths)
                     cols = []
                     fpos = 0
                     upos = 0

@@ -393,3 +393,48 @@ def test_get_after_edge_step_reads_node_features(g, rng):
 def test_limit_after_sample_n_with_types(g, rng):
     res = run_gql(g, "sampleNWithTypes([0, 1], 5).limit(3).as(n)", rng=rng)
     assert res["n"].shape == (2, 3)  # per-type truncation
+
+
+def test_compile_cache_shared_across_instances():
+    # Same query string must hit the module-level compile cache
+    # (reference caches GQL->DAG per query string, compiler.h:112-126)
+    from euler_tpu.query.gql import _compile_cached
+
+    _compile_cached.cache_clear()
+    Query("v([1, 2]).values(dense2).as(f)")
+    info0 = _compile_cached.cache_info()
+    Query("v([1, 2]).values(dense2).as(f)")
+    info1 = _compile_cached.cache_info()
+    assert info1.hits == info0.hits + 1 and info1.misses == info0.misses
+
+
+def test_gql_dispatch_overhead_vs_direct(graph1):
+    # Hot-loop GQL dispatch must stay within ~1.1x the direct batch call
+    # on realistic batches (compile cache + precompiled values plans make
+    # per-call work pure dispatch, compiler.h:112-126). The interpreter's
+    # fixed cost is ~9us/query; a tiny 4-id fetch bounds that absolute
+    # overhead, a 1024-id batch bounds the relative overhead.
+    import time
+
+    def best_of(fn, n, reps=5):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn()
+            best = min(best, time.perf_counter() - t0)
+        return best / n
+
+    src = "v(nodes).values(dense2).as(f)"
+    for n_ids, ratio, n in ((4, 2.0, 300), (1024, 1.3, 60)):
+        ids = np.arange(n_ids, dtype=np.uint64) % 6 + 1
+        Query(src).run(graph1, {"nodes": ids})  # warm compile cache
+        direct = best_of(
+            lambda: graph1.get_dense_feature(ids, ["dense2"]), n
+        )
+        gql = best_of(lambda: Query(src).run(graph1, {"nodes": ids}), n)
+        # cushions over the ~1.1x target absorb scheduler noise and
+        # coverage instrumentation; the assertion is that dispatch
+        # overhead is O(1) per call (measured: 1.27x @ 4 ids, ~1.1x
+        # @ 1024), not O(n)
+        assert gql <= direct * ratio + 40e-6, (n_ids, gql, direct)
