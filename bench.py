@@ -463,8 +463,19 @@ def run_remote(platform: str) -> tuple[float, dict]:
         )
         bf16 = not on_cpu
 
-        def batch_fn():
-            return (flow.minibatch(batch_size),)
+        # overlapped one-RPC minibatches (EULER_BENCH_INFLIGHT outstanding
+        # requests per shard) — the async completion-queue client parity
+        inflight = int(os.environ.get("EULER_BENCH_INFLIGHT", "4"))
+        # the per-shard executor must be at least as deep as the request
+        # window, or the recorded "inflight" would overstate true overlap
+        os.environ.setdefault("EULER_TPU_INFLIGHT", str(inflight))
+        if inflight > 1:
+            from euler_tpu.estimator import pipelined_batches
+
+            batch_fn = pipelined_batches(flow, batch_size, depth=inflight)
+        else:
+            def batch_fn():
+                return (flow.minibatch(batch_size),)
 
         note("warmup + measure")
         value, _ = _measure_training(
@@ -484,6 +495,7 @@ def run_remote(platform: str) -> tuple[float, dict]:
             "steps_per_call": steps_per_call,
             "bf16": bool(bf16),
             "weighted_lean": bool(weighted),
+            "inflight": inflight,
         }
         return value, extra
     finally:

@@ -89,6 +89,41 @@ class SageDataFlow(DataFlow):
         roots = self.graph.sample_node(batch_size, node_type, rng=self.rng)
         return self.query(roots)
 
+    def minibatch_async(self, batch_size: int, node_type: int = -1):
+        """Pipelined minibatch: returns a Future of a MiniBatch with up to
+        EULER_TPU_INFLIGHT requests overlapped per shard, or None when the
+        graph has no async surface (in-process) — callers then use the
+        sync minibatch(). Decode + MiniBatch assembly run in the RPC
+        worker thread (pure numpy; the only shared write is the sticky
+        _lean_off downgrade flag, a benign bool)."""
+        submit = getattr(self.graph, "sage_minibatch_async", None)
+        if submit is None or self.feature_mode != "rows":
+            return None
+        fut = submit(
+            batch_size,
+            self.edge_types,
+            self.fanouts,
+            label=self.label_feature,
+            node_type=node_type,
+            rng=self.rng,
+            lean=self.lean and not self._lean_off,
+        )
+        if fut is None:
+            return None
+
+        import concurrent.futures
+
+        out: concurrent.futures.Future = concurrent.futures.Future()
+
+        def chain(f):
+            try:
+                out.set_result(self._from_remote(f.result()))
+            except BaseException as e:  # propagate to the consumer
+                out.set_exception(e)
+
+        fut.add_done_callback(chain)
+        return out
+
     def _from_remote(self, res: dict) -> MiniBatch:
         roots = np.asarray(res["roots"], np.uint64)
         if res["lean"]:

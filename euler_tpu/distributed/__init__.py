@@ -1,3 +1,8 @@
 from euler_tpu.distributed.client import RemoteShard, RpcError, connect  # noqa: F401
 from euler_tpu.distributed.registry import Registry  # noqa: F401
 from euler_tpu.distributed.service import GraphService, serve_shard  # noqa: F401
+from euler_tpu.distributed.rendezvous import (  # noqa: F401
+    RendezvousServer,
+    TcpRegistry,
+    make_registry,
+)

@@ -18,13 +18,61 @@ import time
 import numpy as np
 
 from euler_tpu.distributed import wire
-from euler_tpu.distributed.registry import Registry
+from euler_tpu.distributed.registry import Registry  # noqa: F401 (re-export)
+from euler_tpu.distributed.rendezvous import make_registry
 from euler_tpu.graph.meta import GraphMeta
 from euler_tpu.graph.store import Graph
 
 
 class RpcError(RuntimeError):
     pass
+
+
+class _DaemonExecutor:
+    """Minimal bounded executor on daemon threads.
+
+    concurrent.futures.ThreadPoolExecutor joins its (non-daemon) workers
+    at interpreter exit — a worker stuck in a connect-retry loop against
+    torn-down shard servers would stall process exit for minutes. Daemon
+    workers + no global join means abandoned in-flight futures die with
+    the process, which is exactly right for fire-and-forget RPC overlap."""
+
+    def __init__(self, max_workers: int, name: str):
+        import queue as queue_mod
+
+        self._q: "queue_mod.Queue" = queue_mod.Queue()
+        self._threads = [
+            threading.Thread(
+                target=self._work, daemon=True, name=f"{name}-{i}"
+            )
+            for i in range(max_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _work(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn, args = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:
+                fut.set_exception(e)
+
+    def submit(self, fn, *args):
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        self._q.put((fut, fn, args))
+        return fut
+
+    def close(self):
+        for _ in self._threads:
+            self._q.put(None)
 
 
 def _seed(rng) -> int:
@@ -100,6 +148,35 @@ class RemoteShard:
         self._lock = threading.Lock()
         self._num_nodes: int | None = None
         self._unit_w: dict[tuple | None, bool] = {}
+        self._pool = None  # lazy in-flight request executor
+
+    def _executor(self) -> _DaemonExecutor:
+        """Bounded executor for overlapped requests — the async
+        completion-queue client's contract (query_proxy.cc:235-256,
+        completion_queue_pool.h): up to EULER_TPU_INFLIGHT (default 4)
+        outstanding RPCs per shard, each worker thread on its own
+        socket (thread-local in _Replica), retry/quarantine preserved."""
+        if self._pool is None:
+            with self._lock:
+                if self._pool is None:
+                    import os
+
+                    depth = int(os.environ.get("EULER_TPU_INFLIGHT", "4"))
+                    self._pool = _DaemonExecutor(
+                        max(depth, 1), f"shard{self.shard}-rpc"
+                    )
+        return self._pool
+
+    def submit(self, op: str, values: list):
+        """Async call: returns a concurrent.futures.Future of call()'s
+        result, overlapping with other in-flight requests to this shard."""
+        return self._executor().submit(self.call, op, values)
+
+    def close(self):
+        """Stop the in-flight executor workers (idempotent)."""
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
 
     @property
     def part(self) -> int:
@@ -313,19 +390,55 @@ class RemoteShard:
         hops), "labels"}; full → {"lean": False, "roots", "hops":
         (ids, w, tt, mask, rows) per-hop lists, "labels"}.
         """
-        counts = [int(c) for c in counts]
-        out = self.call(
-            "sage_minibatch",
-            [
-                int(batch_size),
-                _types(edge_types),
-                counts,
-                label,
-                int(node_type),
-                _seed(rng),
-                bool(lean),
-            ],
+        return self._sage_mb_decode(
+            self.call(*self._sage_mb_req(
+                batch_size, edge_types, counts, label, node_type,
+                _seed(rng), lean,
+            )),
+            [int(c) for c in counts],
         )
+
+    def sage_minibatch_async(
+        self,
+        batch_size,
+        edge_types,
+        counts,
+        label=None,
+        node_type=-1,
+        rng=None,
+        lean=True,
+    ):
+        """Pipelined variant: returns a Future of sage_minibatch's dict.
+        The seed is drawn HERE (caller thread) so a shared Generator is
+        never touched from executor workers; decode runs in the worker."""
+        seed = _seed(rng)
+        counts_i = [int(c) for c in counts]
+        op, values = self._sage_mb_req(
+            batch_size, edge_types, counts, label, node_type, seed, lean
+        )
+        ex = self._executor()
+
+        def run():
+            return self._sage_mb_decode(self.call(op, values), counts_i)
+
+        return ex.submit(run)
+
+    @staticmethod
+    def _sage_mb_req(
+        batch_size, edge_types, counts, label, node_type, seed, lean
+    ):
+        return "sage_minibatch", [
+            int(batch_size),
+            _types(edge_types),
+            [int(c) for c in counts],
+            label,
+            int(node_type),
+            seed,
+            bool(lean),
+        ]
+
+    @staticmethod
+    def _sage_mb_decode(out, counts):
         if out[-1]:
             if len(out) == 5:  # weighted-lean: bf16 weights ride along
                 return {
@@ -499,7 +612,7 @@ def connect(
     if cluster is None:
         if registry_path is None or num_shards is None:
             raise ValueError("need cluster= or (registry_path=, num_shards=)")
-        cluster = Registry(registry_path).wait_for(num_shards, timeout)
+        cluster = make_registry(registry_path).wait_for(num_shards, timeout)
     shards = [
         RemoteShard(s, cluster[s]) for s in sorted(cluster)
     ]

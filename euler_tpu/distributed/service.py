@@ -22,6 +22,7 @@ import numpy as np
 
 from euler_tpu.distributed import wire
 from euler_tpu.distributed.registry import Registry
+from euler_tpu.distributed.rendezvous import make_registry
 from euler_tpu.graph import format as tformat
 from euler_tpu.graph.meta import GraphMeta
 from euler_tpu.graph.store import GraphStore
@@ -564,7 +565,7 @@ def serve_shard(
             store = GraphStore(meta, arrays, shard)
     else:
         store = GraphStore(meta, arrays, shard)
-    registry = Registry(registry_path) if registry_path else None
+    registry = make_registry(registry_path) if registry_path else None
     return GraphService(
         store, meta, shard, host, port, registry, workers=workers
     ).start()

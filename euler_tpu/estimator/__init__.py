@@ -5,6 +5,7 @@ from euler_tpu.estimator.estimator import (  # noqa: F401
     id_batches,
     make_optimizer,
     node_batches,
+    pipelined_batches,
     read_sample_ids,
     sample_file_batches,
     stack_batches,

@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
+import threading
 import time
 from typing import Callable, Iterable, Iterator
 
@@ -525,6 +526,52 @@ def stack_batches(batch_fn: Callable[[], tuple], k: int) -> Callable[[], tuple]:
 
 
 # ---- batch sources (Node/Edge estimator input_fn parity) ----------------
+
+
+def pipelined_batches(
+    flow, batch_size: int, depth: int = 4, node_type: int = -1
+) -> Callable[[], tuple]:
+    """Remote batch source with `depth` overlapped sage_minibatch RPCs.
+
+    The reference client overlaps requests through gRPC completion queues
+    (query_proxy.cc:235-256); here a rolling window of Futures keeps the
+    shard servers busy while the head batch is consumed, hiding one-RPC
+    latency behind its successors. Falls back to sync flow.minibatch when
+    the graph has no async surface (in-process graphs). Thread-safe: may
+    be wrapped in a Prefetcher with multiple workers."""
+    from collections import deque
+
+    pending: deque = deque()
+    lock = threading.Lock()
+    sync_mode = [False]  # sticky downgrade: no async surface / old server
+
+    def fn():
+        with lock:
+            if not sync_mode[0]:
+                while len(pending) < max(depth, 1):
+                    fut = flow.minibatch_async(batch_size, node_type)
+                    if fut is None:  # no async surface → stay sync
+                        sync_mode[0] = True
+                        break
+                    pending.append(fut)
+            if sync_mode[0] and not pending:
+                # sync minibatch under the lock: flow.rng is a shared
+                # numpy Generator, not thread-safe across workers
+                return (flow.minibatch(batch_size, node_type),)
+            head = pending.popleft()
+        try:
+            return (head.result(),)
+        except RuntimeError as e:
+            if "unknown op" not in str(e):
+                raise
+            # pre-async server: downgrade stays sticky — stop refilling
+            # the window with doomed RPCs, drop the in-flight ones
+            with lock:
+                sync_mode[0] = True
+                pending.clear()
+                return (flow.minibatch(batch_size, node_type),)
+
+    return fn
 
 
 def node_batches(

@@ -1438,6 +1438,29 @@ class Graph:
                 return None
             raise
 
+    def sage_minibatch_async(
+        self,
+        batch_size,
+        edge_types,
+        counts,
+        label=None,
+        node_type=-1,
+        rng=None,
+        lean=True,
+    ):
+        """Pipelined sage_minibatch: a Future of the result dict, with up
+        to EULER_TPU_INFLIGHT requests overlapped per shard (the
+        reference's async completion-queue client, query_proxy.cc:235-256).
+        None on in-process graphs or servers without the async surface —
+        callers fall back to the sync path."""
+        if not all(hasattr(s, "sage_minibatch_async") for s in self.shards):
+            return None
+        rng = _rng(rng)
+        pick = int(rng.integers(self.num_shards))
+        return self.shards[pick].sage_minibatch_async(
+            batch_size, edge_types, counts, label, node_type, rng, lean
+        )
+
     def get_dense_by_rows(self, rows, names) -> np.ndarray:
         """Dense features by pre-resolved global rows (-1 → zeros).
 

@@ -36,10 +36,16 @@ def test_wire_roundtrip():
 
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory, fixture_graph_dict):
+    # membership over TCP rendezvous (no shared filesystem, the real
+    # multi-host mode); shared-dir registry mode is covered by the other
+    # cluster fixtures below
+    from euler_tpu.distributed import RendezvousServer
+
     d = tmp_path_factory.mktemp("dist")
     data = str(d / "data")
     convert_json(fixture_graph_dict, data, num_partitions=2)
-    reg = str(d / "registry")
+    rdv = RendezvousServer().start()
+    reg = f"tcp://{rdv.address}"
     services = [
         serve_shard(data, 0, registry_path=reg, native=False),
         serve_shard(data, 1, registry_path=reg, native=False),
@@ -49,11 +55,14 @@ def cluster(tmp_path_factory, fixture_graph_dict):
     yield remote, local, services, data, reg
     for s in services:
         s.stop()
+    rdv.stop()
 
 
 def test_registry_membership(cluster):
+    from euler_tpu.distributed import make_registry
+
     _, _, services, _, reg = cluster
-    table = Registry(reg).lookup(2)
+    table = make_registry(reg).lookup(2)
     assert len(table[0]) == 1 and len(table[1]) == 1
     assert table[0][0][1] == services[0].port
 
@@ -846,3 +855,170 @@ def test_concurrent_clients_bounded_pool(cluster):
     # slack): the same fixed workers served them all
     after = threading.active_count()
     assert after - before <= 2, (before, after)
+
+
+def test_tcp_rendezvous_lifecycle():
+    """Ephemeral-znode semantics over TCP: register → visible; stop →
+    unregistered immediately; dead silent server → expired after ttl
+    (zk_server_register.cc:96-161 contract, no shared filesystem)."""
+    from euler_tpu.distributed import RendezvousServer, TcpRegistry
+
+    srv = RendezvousServer(ttl=0.8).start()
+    try:
+        reg = TcpRegistry(srv.address, ttl=0.8)
+        beat0 = reg.register(0, "127.0.0.1", 7001)
+        beat1 = reg.register(1, "127.0.0.1", 7002)
+        table = reg.wait_for(2, timeout=5.0)
+        assert table[0] == [("127.0.0.1", 7001)]
+        assert table[1] == [("127.0.0.1", 7002)]
+
+        # graceful stop → unreg frame → gone without waiting for ttl
+        beat0.set()
+        deadline = time.time() + 5.0
+        while reg.lookup(2)[0] and time.time() < deadline:
+            time.sleep(0.05)
+        assert reg.lookup(2)[0] == []
+        assert reg.lookup(2)[1] == [("127.0.0.1", 7002)]
+
+        # a heartbeater that dies silently (no unreg) must expire via ttl
+        beat1.set()  # simulate: stop heartbeats, but entry re-added below
+        reg._call("reg", [1, "127.0.0.1", 7002])
+        time.sleep(1.2)  # > ttl with no further heartbeats
+        assert reg.lookup(2)[1] == []
+    finally:
+        srv.stop()
+
+
+def test_tcp_rendezvous_malformed_frame_contained():
+    """Garbage frames must not take the rendezvous down (same containment
+    bar as the graph service wire fuzzing)."""
+    import socket as socket_mod
+    import struct
+
+    from euler_tpu.distributed import RendezvousServer, TcpRegistry
+
+    srv = RendezvousServer().start()
+    try:
+        with socket_mod.create_connection(
+            (srv.host, srv.port), timeout=5.0
+        ) as s:
+            s.sendall(struct.pack("<I", 7) + b"\xff" * 7)
+            s.settimeout(5.0)
+            s.recv(4)  # err reply or close — either way, no crash
+        reg = TcpRegistry(srv.address)
+        reg.register(0, "h", 1)
+        assert reg.wait_for(1, timeout=5.0)[0] == [("h", 1)]
+    finally:
+        srv.stop()
+
+
+def test_tcp_rendezvous_end_to_end_training_batch(tmp_path, fixture_graph_dict):
+    """Full stack over TCP membership: convert → serve 2 shards → connect →
+    one fused sage_minibatch (the north-star deployment has no shared FS)."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.distributed import RendezvousServer
+
+    data = str(tmp_path / "data")
+    convert_json(fixture_graph_dict, data, num_partitions=2)
+    rdv = RendezvousServer().start()
+    reg = f"tcp://{rdv.address}"
+    services = [
+        serve_shard(data, 0, registry_path=reg, native=False),
+        serve_shard(data, 1, registry_path=reg, native=False),
+    ]
+    try:
+        remote = connect(registry_path=reg, num_shards=2)
+        flow = SageDataFlow(
+            remote, ["dense2"], fanouts=[2, 2], label_feature="dense3",
+            rng=np.random.default_rng(0),
+        )
+        batch = flow.minibatch(4)
+        assert all(np.isfinite(f).all() for f in batch.feats)
+        assert batch.labels is not None
+    finally:
+        for s in services:
+            s.stop()
+        rdv.stop()
+
+
+def test_pipelined_minibatch_overlap(unit_cluster, monkeypatch):
+    """N sage_minibatch RPCs must actually be in flight concurrently
+    (async completion-queue client parity, query_proxy.cc:235-256), and
+    the pipelined source must yield valid MiniBatches."""
+    import threading as threading_mod
+
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import pipelined_batches
+
+    remote, local = unit_cluster
+    flow = SageDataFlow(
+        remote, ["dense2"], fanouts=[3, 2], label_feature="dense3",
+        rng=np.random.default_rng(5), feature_mode="rows", lean=True,
+    )
+
+    state = {"active": 0, "peak": 0}
+    gate = threading_mod.Lock()
+    orig = RemoteShard.call
+
+    def tracked(self, op, values):
+        if op == "sage_minibatch":
+            with gate:
+                state["active"] += 1
+                state["peak"] = max(state["peak"], state["active"])
+            time.sleep(0.05)  # hold the request open so overlap is visible
+            try:
+                return orig(self, op, values)
+            finally:
+                with gate:
+                    state["active"] -= 1
+        return orig(self, op, values)
+
+    monkeypatch.setattr(RemoteShard, "call", tracked)
+    src = pipelined_batches(flow, batch_size=4, depth=4)
+    batches = [src() for _ in range(6)]
+    for (b,) in batches:
+        assert all(np.isfinite(np.asarray(f)).all() for f in b.feats)
+        assert b.labels is not None
+    assert state["peak"] >= 2, state  # true overlap, not serialized
+
+
+def test_pipelined_batches_sync_fallback(graph1):
+    """In-process graphs have no async surface: the pipelined source must
+    degrade to plain sync minibatches, not crash."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import pipelined_batches
+
+    flow = SageDataFlow(
+        graph1, ["dense2"], fanouts=[2], label_feature="dense3",
+        rng=np.random.default_rng(0),
+    )
+    src = pipelined_batches(flow, batch_size=4, depth=4)
+    (b,) = src()
+    assert all(np.isfinite(np.asarray(f)).all() for f in b.feats)
+
+
+def test_pipelined_training_end_to_end(unit_cluster, tmp_path):
+    """Estimator training over the pipelined source converges finitely and
+    failover machinery stays intact (same stack as the remote bench)."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import (
+        Estimator,
+        EstimatorConfig,
+        pipelined_batches,
+    )
+    from euler_tpu.nn import SuperviseModel
+
+    remote, _ = unit_cluster
+    flow = SageDataFlow(
+        remote, ["dense2"], fanouts=[2], label_feature="dense3",
+        rng=np.random.default_rng(1),
+    )
+    model = SuperviseModel(conv="sage", dims=[8], label_dim=3)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "pm"), total_steps=4, log_steps=10**9
+    )
+    est = Estimator(
+        model, pipelined_batches(flow, batch_size=4, depth=3), cfg
+    )
+    hist = est.train(save=False)
+    assert np.isfinite(hist).all()
