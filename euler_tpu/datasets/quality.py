@@ -60,6 +60,7 @@ def products_like_graph(
     train_frac: float = 0.08,
     val_frac: float = 0.02,
     seed: int = 0,
+    num_partitions: int = 1,
 ):
     """ogbn-products-shaped stand-in for the NORTH-STAR quality config
     (BASELINE.json: GraphSAGE node-classification on ogbn-products).
@@ -135,6 +136,7 @@ def products_like_graph(
     indptr = np.r_[0, np.cumsum(deg)]
     from euler_tpu.graph.meta import FeatureSpec, GraphMeta
 
+    P = int(num_partitions)
     meta = GraphMeta(
         num_node_types=3,
         num_edge_types=1,
@@ -143,29 +145,44 @@ def products_like_graph(
             "label": FeatureSpec("label", "dense", 1, num_classes),
         },
         edge_features={},
-        num_partitions=1,
+        num_partitions=P,
     )
-    meta.node_weight_sums = [[float((types == t).sum()) for t in range(3)]]
-    meta.edge_weight_sums = [[float(e)]]
-    arrays = {
-        "node_ids": ids,
-        "node_types": types.astype(np.int32),
-        "node_weights": np.ones(num_nodes, np.float32),
-        "edge_src": ids[src_s],
-        "edge_dst": ids[dst_s],
-        "edge_types": np.zeros(e, np.int32),
-        "edge_weights": np.ones(e, np.float32),
-        "adj_0_indptr": indptr,
-        "adj_0_dst": ids[dst_s],
-        "adj_0_w": np.ones(e, np.float32),
-        "adj_0_eidx": np.arange(e, dtype=np.int64),
-        "nf_dense_0": feat.astype(np.float32),
-        "nf_dense_1": labels,
-        "glabel_indptr": np.zeros(1, np.int64),
-        "glabel_nodes": np.zeros(0, np.uint64),
-    }
-    store = GraphStore(meta, arrays, part=0)
-    return Graph(meta, [store]), types
+    feat32 = feat.astype(np.float32)
+    stores = []
+    meta.node_weight_sums = []
+    meta.edge_weight_sums = []
+    for p in range(P):
+        own = np.nonzero(ids % np.uint64(P) == p)[0]  # id%P ownership
+        # per-partition CSR: rows of the (src-sorted) global CSR, sliced
+        # and re-packed with the standard repeat-offset trick
+        lens = deg[own]
+        starts = indptr[own]
+        total = int(lens.sum())
+        row0 = np.repeat(np.cumsum(lens) - lens, lens)
+        idx = np.repeat(starts, lens) + (np.arange(total) - row0)
+        meta.node_weight_sums.append(
+            [float((types[own] == t).sum()) for t in range(3)]
+        )
+        meta.edge_weight_sums.append([float(total)])
+        arrays = {
+            "node_ids": ids[own],
+            "node_types": types[own].astype(np.int32),
+            "node_weights": np.ones(len(own), np.float32),
+            "edge_src": ids[src_s[idx]],
+            "edge_dst": ids[dst_s[idx]],
+            "edge_types": np.zeros(total, np.int32),
+            "edge_weights": np.ones(total, np.float32),
+            "adj_0_indptr": np.r_[0, np.cumsum(lens)],
+            "adj_0_dst": ids[dst_s[idx]],
+            "adj_0_w": np.ones(total, np.float32),
+            "adj_0_eidx": np.arange(total, dtype=np.int64),
+            "nf_dense_0": feat32[own],
+            "nf_dense_1": labels[own],
+            "glabel_indptr": np.zeros(1, np.int64),
+            "glabel_nodes": np.zeros(0, np.uint64),
+        }
+        stores.append(GraphStore(meta, arrays, part=p))
+    return Graph(meta, stores), types
 
 
 def citeseer_like_json(seed: int = 0) -> dict:
@@ -428,36 +445,12 @@ def cora_like_json(
     types[rest[:val_n]] = 1
     types[rest[val_n : val_n + test_n]] = 2
 
-    nodes = []
+    feats = np.zeros((num_nodes, feature_dim), np.float32)
     for i in range(num_nodes):
-        feat = np.zeros(feature_dim, dtype=np.float32)
-        feat[feat_rows[i]] = 1.0
-        label = np.zeros(num_classes, dtype=np.float32)
-        label[classes[i]] = 1.0
-        nodes.append(
-            {
-                "id": i + 1,
-                "type": int(types[i]),
-                "weight": 1.0,
-                "features": [
-                    {"name": "feature", "type": "dense", "value": feat.tolist()},
-                    {"name": "label", "type": "dense", "value": label.tolist()},
-                ],
-            }
-        )
-    edges = []
-    for i, j in pairs:
-        for s, d in ((i, j), (j, i)):
-            edges.append(
-                {
-                    "src": s + 1,
-                    "dst": d + 1,
-                    "type": 0,
-                    "weight": 1.0,
-                    "features": [],
-                }
-            )
-    return {"nodes": nodes, "edges": edges}
+        feats[i, feat_rows[i]] = 1.0
+    labels = np.zeros((num_nodes, num_classes), np.float32)
+    labels[np.arange(num_nodes), classes] = 1.0
+    return _emit_node_class_json(feats, labels, types, pairs)
 
 
 def _emit_node_class_json(feats, labels, types, pairs) -> dict:
