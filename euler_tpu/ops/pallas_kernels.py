@@ -109,11 +109,13 @@ def _reference_forward(x, slots, w):
 
 # Where the DMA kernel beats XLA's gather+einsum, measured on v5e
 # (ops/PALLAS_BENCH.md has the full grid): auto picks the fused kernel in
-# the region validated end-to-end (+14% GraphSAGE at f=128); f > 128 is
-# fully supported via the chunked two-level gather (k row copies of 128
-# lanes per neighbor) and selectable with impl='pallas' — the
-# tunnel-proxied chip here can't produce trustworthy microbenchmarks to
-# extend the auto region (see PALLAS_BENCH.md).
+# the region validated end-to-end (+14% GraphSAGE at f=128 in r2;
+# re-confirmed r5: 5.12M vs 3.25M edges/s back to back). The 128 cap is a
+# MEASURED boundary, not caution: the r5 on-chip wide-F A/B (dims 256,
+# artifacts/widef_{off,pallas}.json) has XLA at 8.18M vs pallas 5.18M
+# edges/s — at f > 128 the chunked gather's k-fold DMA descriptors lose
+# to XLA's single-stream fused gather+einsum. f > 128 stays fully
+# supported via impl='pallas' for chips where that tradeoff shifts.
 _PALLAS_AUTO_MAX_F = 128
 _PALLAS_MIN_DST = 4096
 
