@@ -1,0 +1,61 @@
+"""Ring-parallel full-graph GCN — whole-graph training sharded over a mesh
+axis (nodes, edges, AND activations partitioned).
+
+The reference's full-graph models (tf_euler whole-graph GCN path,
+examples/gcn) hold the entire Â and activation matrices on one device;
+this model is the long-context analog: node rows and edge buckets shard
+over the `model` axis and every propagation runs
+`parallel.sp.ring_segment_sum` — a P-step ppermute ring identical in
+schedule to ring attention. Per-device memory is O(N/P·F + E/P); nothing
+ever materializes [N, F] or [E, F] on one device.
+
+Usage (see tests/test_sp_ring.py for the full parity harness):
+
+    buckets, ids = bucket_full_graph(graph, parts=mesh.shape['model'])
+    model = SPFullGraphGCN(dims=[64, 64], label_dim=C)
+    dev_buckets, x = put_ring(mesh, buckets, features_of(ids))
+    logits = model.apply(params, x, dev_buckets, mesh)
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from euler_tpu.parallel.sp import ring_segment_sum
+
+
+class SPFullGraphGCN(nn.Module):
+    """GCN stack where every Â·H propagation is a ring pass.
+
+    dims: hidden widths per layer; label_dim: classifier width.
+    The GCN normalization lives in the bucket weights
+    (`bucket_full_graph(..., norm='gcn')`), so each layer is exactly
+    ring(Â) → dense → relu, and the head is a dense classifier on the
+    (row-sharded) final features.
+    """
+
+    dims: tuple | list
+    label_dim: int
+
+    @nn.compact
+    def __call__(self, x, buckets, mesh, axis: str = "model"):
+        h = x
+        for d in self.dims:
+            h = ring_segment_sum(h, buckets, mesh, axis)
+            h = nn.Dense(d)(h)
+            h = nn.relu(h)
+        return nn.Dense(self.label_dim)(h)
+
+
+def masked_softmax_xent(logits, labels_onehot, mask):
+    """Mean cross-entropy over mask=True rows (padded rows contribute 0).
+
+    logits/labels row-sharded the same way; the mean is a global scalar
+    (jnp reductions over sharded arrays produce the full reduction).
+    """
+    logp = jax.nn.log_softmax(logits)
+    per_row = -jnp.sum(labels_onehot * logp, axis=-1)
+    m = mask.astype(per_row.dtype)
+    return jnp.sum(per_row * m) / jnp.maximum(jnp.sum(m), 1.0)
