@@ -288,7 +288,7 @@ def spawn(args) -> int:
     return 0
 
 
-def main(argv=None):
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--spawn", type=int, default=0,
                     help="parent mode: launch N localhost workers")
@@ -307,7 +307,11 @@ def main(argv=None):
     ap.add_argument("--slots", type=int, default=0,
                     help="global stream slots per step (default: process "
                          "count); a 1-process run replays all slots")
-    args = ap.parse_args(argv)
+    return ap
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
     if args.spawn:
         return spawn(args)
     worker(args)

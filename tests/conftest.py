@@ -12,6 +12,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Persistent compilation cache (test-gate budget, VERDICT r3 #3): many
+# tests jit byte-identical Estimator/train-step programs — the disk cache
+# dedupes those compiles within a single cold run, and spawned subprocess
+# tests (multihost, service CLIs) inherit it through the env vars.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", "/tmp/euler_tpu_test_jax_cache"
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
 import jax
 

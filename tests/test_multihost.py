@@ -25,15 +25,23 @@ def _run(cmd, extra_env=None):
     raise AssertionError(f"no JSON line in output: {r.stdout[-500:]}")
 
 
+def _single_inprocess(argv):
+    """The 1-process baseline leg runs IN-PROCESS (the losses are
+    device-count independent by design — exactly what these tests assert —
+    so the pytest process's 8-device mesh serves as the single-process
+    run, saving a cold python+jax startup per test)."""
+    from euler_tpu.examples import run_multihost
+
+    return run_multihost.worker(run_multihost.build_parser().parse_args(argv))
+
+
 def test_two_process_matches_single_process():
     mod = "euler_tpu.examples.run_multihost"
     multi = _run(
         [sys.executable, "-m", mod, "--spawn", "2", "--steps", "5",
          "--port", "12391"]
     )["multihost_losses"]
-    single = _run(
-        [sys.executable, "-m", mod, "--steps", "5"]
-    )["losses"]
+    single = _single_inprocess(["--steps", "5"])
     np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
     assert multi[-1] < multi[0]  # it actually trains
 
@@ -82,7 +90,7 @@ def test_multihost_trainers_with_remote_graph_service(tmp_path):
             [sys.executable, "-m", mod, "--spawn", "2",
              "--port", "12394", *common]
         )["multihost_losses"]
-        single = _run([sys.executable, "-m", mod, *common])["losses"]
+        single = _single_inprocess(common)
         np.testing.assert_allclose(multi, single, rtol=1e-4, atol=1e-5)
         assert multi[-1] < multi[0]  # it actually trains
     finally:
