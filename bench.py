@@ -254,7 +254,11 @@ def run(platform: str) -> tuple[float, dict]:
             for x in os.environ.get("EULER_BENCH_DIMS", "128,128").split(",")
         ]
         batch_size, fanouts = 1024, [10, 10]
-        warmup, steps, steps_per_call = 32, 480, 16
+        # EULER_BENCH_STEPS_PER_CALL: scan depth per dispatch — the lever
+        # that amortizes the tunnel's per-dispatch round trip (extras
+        # sweep: deeper scans when RTT dominates a run)
+        steps_per_call = int(os.environ.get("EULER_BENCH_STEPS_PER_CALL", 16))
+        warmup, steps = 2 * steps_per_call, 30 * steps_per_call
 
     rng = np.random.default_rng(0)
     graph = random_graph(

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Round-5 leftover chip-gated measurements, run when the tunnel is alive
+# (tpu_suite.sh already captured headline/KG/wide-F this round):
+#   1. weighted-lean remote leg (EULER_BENCH_WEIGHTED=1) — the one
+#      remote variant VERDICT r4 #1 lists that has no on-chip number
+#   2. two extra headline local runs — variance band for the 5.12M
+#      number (r2 measured 7.55M; the tunnel-proxied chip fluctuates)
+#
+#   bash euler_tpu/tools/tpu_extras.sh [outdir]
+set -u
+cd "$(dirname "$0")/../.."
+OUT="${1:-/tmp/etpu_tpu_extras}"
+mkdir -p "$OUT"
+
+probe=$(timeout 120 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+echo "# platform probe: ${probe:-unreachable}"
+if [ "${probe:-}" != "tpu" ] && [ "${probe:-}" != "axon" ]; then
+  echo "# no chip — nothing measured" && exit 1
+fi
+
+echo "# 1/2 weighted-lean remote leg"
+EULER_BENCH_WEIGHTED=1 timeout 1200 python bench.py | tee "$OUT/bench_weighted.json"
+
+echo "# 2/3 headline variance (2 local-only runs)"
+for i in 1 2; do
+  EULER_BENCH_REMOTE=0 timeout 600 python bench.py | tee "$OUT/local_rerun_$i.json"
+done
+
+echo "# 3/3 scan-depth sweep (amortize tunnel RTT)"
+for k in 32 64; do
+  EULER_BENCH_REMOTE=0 EULER_BENCH_STEPS_PER_CALL=$k \
+    timeout 600 python bench.py | tee "$OUT/local_k$k.json"
+done
+echo "# done → $OUT"
