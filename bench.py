@@ -1,9 +1,12 @@
 """Headline benchmark: sampled edges/sec training GraphSAGE on one chip.
 
 Trains supervised GraphSAGE (fanout sampling + mean-aggregator convs) on a
-synthetic random graph, with host-side sampling prefetched on worker threads
-overlapping the jitted device step. Metric matches the north star in
-BASELINE.json: sampled edges/sec/chip (target 2M on v5e).
+synthetic random graph. The local leg samples ON DEVICE by default
+(DeviceSageFlow: HBM-resident adjacency, per-step PRNG keys, zero wire
+bytes); EULER_BENCH_DEVICE_FLOW=0 selects the host path (sampling on
+prefetch worker threads + lean int32-rows wire), which the remote leg
+always exercises. Metric matches the north star in BASELINE.json:
+sampled edges/sec/chip (target 2M on v5e).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "edges/s", "vs_baseline": N/2e6}
@@ -187,14 +190,19 @@ def _measure_training(
 
         conv_kwargs = {"dtype": jnp.bfloat16}
     model = GraphSAGESupervised(dims=dims, label_dim=2, conv_kwargs=conv_kwargs)
-    # workers stage K-step stacked batches onto the device so H2D and host
-    # sampling overlap the scanned device steps
-    prefetch = Prefetcher(
-        stack_batches(batch_fn, steps_per_call),
-        depth=4,
-        workers=4,
-        device_put=True,
-    )
+    if getattr(batch_fn, "is_device_flow", False):
+        # on-device sampling: batches are traced inside the scanned train
+        # step from PRNG keys — no host sampling, no prefetch, no wire
+        prefetch = batch_fn
+    else:
+        # workers stage K-step stacked batches onto the device so H2D and
+        # host sampling overlap the scanned device steps
+        prefetch = Prefetcher(
+            stack_batches(batch_fn, steps_per_call),
+            depth=4,
+            workers=4,
+            device_put=True,
+        )
     try:
         est = Estimator(
             model,
@@ -219,7 +227,8 @@ def _measure_training(
         jax.block_until_ready(est.params)
         dt = time.perf_counter() - t0
     finally:
-        prefetch.close()
+        if hasattr(prefetch, "close"):
+            prefetch.close()
     return steps * edges_per_step / dt, edges_per_step
 
 
@@ -286,17 +295,31 @@ def run(platform: str) -> tuple[float, dict]:
     from euler_tpu.estimator import DeviceFeatureCache
 
     cache = DeviceFeatureCache(graph, ["feat"])
-    # lean wire: ship int32 rows + labels only; edge ids, masks, and the
-    # (uniform) weights are rebuilt on device — ~3x fewer H2D bytes
-    flow = SageDataFlow(
-        graph, ["feat"], fanouts=fanouts, label_feature="label", rng=rng,
-        feature_mode="rows", lean=True,
-    )
     bf16 = BF16 or (not on_cpu and "--fp32" not in sys.argv)
 
-    def batch_fn():
-        roots = graph.sample_node(batch_size, rng=np.random.default_rng())
-        return (flow.query(roots),)
+    # EULER_BENCH_DEVICE_FLOW=0 falls back to the host sampling + lean
+    # wire path (the remote leg always exercises that wire); the default
+    # samples on device — adjacency lives in HBM next to the features,
+    # and the only per-step input is a PRNG key
+    device_flow = os.environ.get("EULER_BENCH_DEVICE_FLOW", "1") != "0"
+    if device_flow:
+        from euler_tpu.dataflow import DeviceSageFlow
+
+        batch_fn = DeviceSageFlow(
+            graph, fanouts=fanouts, batch_size=batch_size,
+            label_feature="label",
+        )
+    else:
+        # lean wire: ship int32 rows + labels only; edge ids, masks, and
+        # the (uniform) weights are rebuilt on device — ~3x fewer H2D bytes
+        flow = SageDataFlow(
+            graph, ["feat"], fanouts=fanouts, label_feature="label", rng=rng,
+            feature_mode="rows", lean=True,
+        )
+
+        def batch_fn():
+            roots = graph.sample_node(batch_size, rng=np.random.default_rng())
+            return (flow.query(roots),)
 
     value, _ = _measure_training(
         batch_fn, cache, dims, batch_size, fanouts,
@@ -304,7 +327,7 @@ def run(platform: str) -> tuple[float, dict]:
     )
     extra = {"backend": platform + ("-fallback" if CPU_FALLBACK else ""),
              "native_engine": bool(native), "bf16": bool(bf16),
-             "steps_per_call": steps_per_call}
+             "steps_per_call": steps_per_call, "device_flow": device_flow}
     return value, extra
 
 

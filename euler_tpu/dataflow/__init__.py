@@ -1,4 +1,5 @@
 from euler_tpu.dataflow.base import Block, DataFlow, MiniBatch, fanout_block  # noqa: F401
+from euler_tpu.dataflow.device import DeviceSageFlow  # noqa: F401
 from euler_tpu.dataflow.sage import FullNeighborDataFlow, SageDataFlow  # noqa: F401
 from euler_tpu.dataflow.walk import gen_pair  # noqa: F401
 from euler_tpu.dataflow.whole import (  # noqa: F401

@@ -84,6 +84,12 @@ class Estimator:
         (the published TransR training protocol)."""
         self.model = model
         self.batch_fn = batch_fn
+        # a DeviceSageFlow (is_device_flow) generates batches ON the
+        # device inside the jitted step from per-step PRNG keys — the
+        # drivers then ship keys instead of batches (zero wire bytes)
+        self._device_flow = (
+            batch_fn if getattr(batch_fn, "is_device_flow", False) else None
+        )
         self.cfg = cfg or EstimatorConfig()
         self.mesh = mesh  # jax.sharding.Mesh → data-parallel + sharded tables
         # DeviceFeatureCache: batches arrive as int32 feature rows and are
@@ -97,6 +103,14 @@ class Estimator:
         # models may declare extra rng collections (e.g. VGAE's "reparam")
         self._rng_names = tuple(getattr(model, "rng_collections", ()))
         self._base_key = jax.random.PRNGKey((cfg or EstimatorConfig()).seed + 1)
+        # device-flow sampling keys: folded per GLOBAL step, so the batch
+        # sequence is deterministic and independent of steps_per_call
+        self._flow_key = jax.random.PRNGKey(self.cfg.seed + 2)
+        if self._device_flow is not None and mesh is not None:
+            raise NotImplementedError(
+                "device-flow batches under a mesh are not wired yet — "
+                "use a host batch_fn for multi-device training"
+            )
         self._jit_train = None
         self._jit_train_scan = None
         self._jit_eval = None
@@ -144,11 +158,14 @@ class Estimator:
             )
             self.opt_state = self.tx.init(self.params)
             return
-        batch = self._put(
-            self.batch_fn(), stacked=self.cfg.steps_per_call > 1
-        )
-        if self.cfg.steps_per_call > 1:  # stacked [K, ...] → init on slice 0
-            batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        if self._device_flow is not None:
+            batch = (jax.jit(self._device_flow.sample)(self._flow_keys(0, 1)[0]),)
+        else:
+            batch = self._put(
+                self.batch_fn(), stacked=self.cfg.steps_per_call > 1
+            )
+            if self.cfg.steps_per_call > 1:  # stacked [K,...] → init slice 0
+                batch = jax.tree_util.tree_map(lambda x: x[0], batch)
         batch = self._hydrate(batch)
         key = jax.random.PRNGKey(self.cfg.seed)
         keys = jax.random.split(key, 1 + len(self._rng_names))
@@ -186,6 +203,28 @@ class Estimator:
         k = jax.random.fold_in(self._base_key, step)
         return dict(zip(self._rng_names, jax.random.split(k, len(self._rng_names))))
 
+    def _apply_update(self, params, opt_state, step_rngs, batch):
+        """One traced optimizer step: hydrate → loss/grad → update."""
+        batch = self._hydrate(batch)
+
+        def loss_fn(p):
+            _, loss, _, metric = self.model.apply(p, *batch, rngs=step_rngs)
+            return loss, metric
+
+        (loss, metric), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, metric
+
+    def _step_batch(self, xs):
+        """Per-step scan/step input → model args. Host flows ship the
+        batch itself; device flows ship a PRNG key and sample on device."""
+        if self._device_flow is not None:
+            return (self._device_flow.sample(xs[0]),)
+        return xs
+
     def _train_step(self):
         if self._jit_train is None:
 
@@ -194,26 +233,16 @@ class Estimator:
             # model state (the big cost for sharded embedding tables)
             @functools.partial(jax.jit, donate_argnums=(0, 1))
             def train_step(params, opt_state, rngs, *batch):
-                batch = self._hydrate(batch)
-
-                def loss_fn(p):
-                    _, loss, _, metric = self.model.apply(
-                        p, *batch, rngs=rngs
-                    )
-                    return loss, metric
-
-                (loss, metric), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(params)
-                updates, opt_state = self.tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return params, opt_state, loss, metric
+                return self._apply_update(
+                    params, opt_state, rngs, self._step_batch(batch)
+                )
 
             self._jit_train = train_step
         return self._jit_train
 
     def _train_step_scan(self):
-        """K optimizer steps per dispatch via lax.scan over stacked batches."""
+        """K optimizer steps per dispatch via lax.scan over stacked batches
+        (host flows) or per-step sampling keys (device flows)."""
         if self._jit_train_scan is None:
 
             @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -221,21 +250,9 @@ class Estimator:
                 def body(carry, xs):
                     params, opt_state = carry
                     step_rngs, batch = xs
-                    batch = self._hydrate(batch)
-
-                    def loss_fn(p):
-                        _, loss, _, metric = self.model.apply(
-                            p, *batch, rngs=step_rngs
-                        )
-                        return loss, metric
-
-                    (loss, metric), grads = jax.value_and_grad(
-                        loss_fn, has_aux=True
-                    )(params)
-                    updates, opt_state = self.tx.update(
-                        grads, opt_state, params
+                    params, opt_state, loss, metric = self._apply_update(
+                        params, opt_state, step_rngs, self._step_batch(batch)
                     )
-                    params = optax.apply_updates(params, updates)
                     return (params, opt_state), (loss, metric)
 
                 (params, opt_state), (losses, metrics) = jax.lax.scan(
@@ -250,6 +267,23 @@ class Estimator:
         if not self._rng_names:
             return None
         return jax.vmap(lambda s: self._rngs(s))(jnp.arange(step, step + k))
+
+    def _flow_keys(self, step: int, k: int):
+        """[k]-stacked device-flow sampling keys for global steps
+        step..step+k (fold_in per step: the batch stream is reproducible
+        and invariant to how steps are grouped into dispatches)."""
+        return jax.vmap(lambda s: jax.random.fold_in(self._flow_key, s))(
+            jnp.arange(step, step + k)
+        )
+
+    def _next_batch(self, k: int):
+        """One dispatch's batch args: K-stacked host batch or K sampling
+        keys (device flow)."""
+        if self._device_flow is not None:
+            if k > 1:
+                return (self._flow_keys(self.step, k),)
+            return (jax.random.fold_in(self._flow_key, self.step),)
+        return self._put(self.batch_fn(), stacked=k > 1)
 
     # -- drivers (train/evaluate/infer/train_and_evaluate) ---------------
 
@@ -279,7 +313,7 @@ class Estimator:
                 profiling = True
                 profile_stop = self.step + self.cfg.profile_steps
                 self._profiled = True
-            batch = self._put(self.batch_fn())
+            batch = self._next_batch(1)
             self.params, self.opt_state, loss, metric = step_fn(
                 self.params, self.opt_state, self._rngs(self.step), *batch
             )
@@ -338,7 +372,7 @@ class Estimator:
                 profiling = True
                 profile_stop = self.step + max(self.cfg.profile_steps, k)
                 self._profiled = True
-            batch = self._put(self.batch_fn(), stacked=True)
+            batch = self._next_batch(k)
             rngs = self._rngs_stacked(self.step, k)
             self.params, self.opt_state, losses, metric = step_fn(
                 self.params, self.opt_state, rngs, *batch
@@ -370,7 +404,11 @@ class Estimator:
             jax.profiler.stop_trace()
         if remainder:
             single = self._train_step()
-            item = self._put(self.batch_fn(), stacked=True)
+            item = (
+                (self._flow_keys(self.step, remainder),)
+                if self._device_flow is not None
+                else self._put(self.batch_fn(), stacked=True)
+            )
             for i in range(remainder):
                 batch = jax.tree_util.tree_map(lambda x: x[i], item)
                 self.params, self.opt_state, loss, _ = single(

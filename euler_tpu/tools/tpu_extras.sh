@@ -1,10 +1,15 @@
 #!/usr/bin/env bash
-# Round-5 leftover chip-gated measurements, run when the tunnel is alive
-# (tpu_suite.sh already captured headline/KG/wide-F this round):
-#   1. weighted-lean remote leg (EULER_BENCH_WEIGHTED=1) — the one
-#      remote variant VERDICT r4 #1 lists that has no on-chip number
-#   2. two extra headline local runs — variance band for the 5.12M
-#      number (r2 measured 7.55M; the tunnel-proxied chip fluctuates)
+# Round-5 chip-gated measurements beyond tpu_suite.sh, run when the
+# tunnel is alive:
+#   1. weighted-lean remote leg (EULER_BENCH_WEIGHTED=1 --remote-only) —
+#      the one remote variant VERDICT r4 #1 lists with no on-chip number
+#   2. device-flow headline (new default path: on-device sampling from
+#      HBM adjacency, zero per-step wire bytes)
+#   3. host-path headline rerun (EULER_BENCH_DEVICE_FLOW=0) — variance
+#      band around the 5.12M host-sampling number from tpu_suite.sh; the
+#      pin keeps the comparison apples-to-apples after the default flip
+#   4. scan-depth sweep on the device-flow path (per-dispatch RTT
+#      amortization)
 #
 #   bash euler_tpu/tools/tpu_extras.sh [outdir]
 set -u
@@ -18,17 +23,23 @@ if [ "${probe:-}" != "tpu" ] && [ "${probe:-}" != "axon" ]; then
   echo "# no chip — nothing measured" && exit 1
 fi
 
-echo "# 1/2 weighted-lean remote leg"
-EULER_BENCH_WEIGHTED=1 timeout 1200 python bench.py | tee "$OUT/bench_weighted.json"
+echo "# 1/4 weighted-lean remote leg (remote-only)"
+EULER_BENCH_WEIGHTED=1 timeout 900 python bench.py --remote-only \
+  | tee "$OUT/bench_weighted.json"
 
-echo "# 2/3 headline variance (2 local-only runs)"
+echo "# 2/4 device-flow headline (2 runs)"
 for i in 1 2; do
-  EULER_BENCH_REMOTE=0 timeout 600 python bench.py | tee "$OUT/local_rerun_$i.json"
+  EULER_BENCH_REMOTE=0 timeout 600 python bench.py \
+    | tee "$OUT/devflow_$i.json"
 done
 
-echo "# 3/3 scan-depth sweep (amortize tunnel RTT)"
+echo "# 3/4 host-path headline rerun (variance band for the 5.12M row)"
+EULER_BENCH_REMOTE=0 EULER_BENCH_DEVICE_FLOW=0 timeout 600 python bench.py \
+  | tee "$OUT/hostflow_rerun.json"
+
+echo "# 4/4 scan-depth sweep (device flow, k=32/64)"
 for k in 32 64; do
   EULER_BENCH_REMOTE=0 EULER_BENCH_STEPS_PER_CALL=$k \
-    timeout 600 python bench.py | tee "$OUT/local_k$k.json"
+    timeout 600 python bench.py | tee "$OUT/devflow_k$k.json"
 done
 echo "# done → $OUT"
