@@ -13,8 +13,14 @@ This is the TPU-first answer to the reference's sample_fanout kernel
 tf_euler/python/euler_ops/neighbor_ops.py): instead of a host-side C++
 sampler feeding the accelerator, the sampler IS accelerator code — a
 [N+1, D] int32 gather plus vectorized uniform draws, fused by XLA into
-the same program as the model. Uniform-weight graphs only (the lean-wire
-contract, sage.py `lean_wire_ok`); weighted graphs keep the host flows.
+the same program as the model. Weighted graphs are first-class: edge
+draws invert a per-row cumulative-weight CDF with a [W, k, D] compare-
+reduce (pure VPU work; D is the guarded max degree), and weighted root
+draws binary-search a uint32-quantized node-weight CDF — the same
+weighted-with-replacement distribution the host samplers and the C++
+engine's alias tables draw from (graph_engine.cc `AliasTable`). Batches
+from a weighted graph carry bf16 edge weights, matching the host
+weighted-lean wire (sage.py `_lean_w`) leaf-for-leaf.
 
 Memory: the padded adjacency costs (N+1)·Dmax·4 bytes of HBM (row+1
 encoding, 0 = padding). For bounded-degree graphs this is small (200k
@@ -58,7 +64,9 @@ class DeviceSageFlow:
         roots_pool: np.ndarray | None = None,
     ):
         """roots_pool: optional node ids to sample roots from (e.g. a
-        train split); default is every node. max_degree is a guard on the
+        train split); default is every node. Root draws are proportional
+        to node weights either way (uniform when weights are constant —
+        host sample_node parity). max_degree is a guard on the
         staged adjacency width ((N+1)·Dmax·4 bytes of HBM): construction
         raises when the graph's true max degree exceeds it — truncation
         would bias sampling, so it is never done silently. The default
@@ -75,19 +83,6 @@ class DeviceSageFlow:
                 "DeviceSageFlow stages the full adjacency host-side and "
                 "needs local shards (remote graphs keep the host flows)"
             )
-        # root draws are uniform; that only matches the host path's
-        # weight-proportional sample_node when node weights are constant
-        w0 = float(np.asarray(graph.shards[0].node_weights[:1])[0]) if len(
-            graph.shards[0].node_weights
-        ) else 1.0
-        if not all(
-            np.all(np.asarray(s.node_weights) == w0) for s in graph.shards
-        ):
-            raise ValueError(
-                "DeviceSageFlow samples roots uniformly; this graph has "
-                "non-uniform node weights — use the host SageDataFlow so "
-                "sample_node honors them"
-            )
         ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
         n = len(ids)
         dmax = int(graph.max_degree(ids, edge_types))
@@ -100,41 +95,75 @@ class DeviceSageFlow:
             )
         adj = np.zeros((n + 1, dmax), dtype=np.int32)
         deg = np.zeros(n + 1, dtype=np.int32)
+        wtab = np.zeros((n + 1, dmax), dtype=np.float32)
+        unit_w = True
         for lo in range(0, n, _STAGE_CHUNK):
             sub = ids[lo : lo + _STAGE_CHUNK]
             nbr, w, _, mask, _ = graph.get_full_neighbor(
                 sub, edge_types, max_degree=dmax
             )
-            if not np.all(w[mask] == 1.0):
-                raise ValueError(
-                    "DeviceSageFlow samples uniformly; this graph has "
-                    "non-unit edge weights — use the host SageDataFlow "
-                    "(weighted-lean wire) instead"
-                )
+            unit_w = unit_w and bool(np.all(w[mask] == 1.0))
             rows = graph.lookup_rows(nbr.ravel()).reshape(nbr.shape)
             # row+1 encoding, 0 = padding (matches DeviceFeatureCache's
             # zero row); masked or unknown neighbors collapse to padding
             block = np.where(mask & (rows >= 0), rows + 1, 0).astype(np.int32)
             # compact valid entries to the front so idx < deg hits them
             order = np.argsort(block == 0, axis=1, kind="stable")
-            adj[1 + lo : 1 + lo + len(sub), : block.shape[1]] = np.take_along_axis(
-                block, order, axis=1
+            sl = slice(1 + lo, 1 + lo + len(sub))
+            adj[sl, : block.shape[1]] = np.take_along_axis(block, order, axis=1)
+            wtab[sl, : block.shape[1]] = np.take_along_axis(
+                np.where(block > 0, w, 0.0).astype(np.float32), order, axis=1
             )
-            deg[1 + lo : 1 + lo + len(sub)] = (block > 0).sum(axis=1)
+            deg[sl] = (block > 0).sum(axis=1)
+        # a positive-degree row whose weights are all zero is unsampleable
+        # (host _WeightedSampler semantics: zero total → padding)
+        deg[wtab.sum(axis=1) <= 0.0] = 0
         self.adj = jax.device_put(adj)
         self.deg = jax.device_put(deg)
+        self.unit_w = unit_w
+        if unit_w:
+            self.cumw = self.wtab = None
+        else:
+            # inverse-CDF tables: idx = #{t : cum[t] <= u·total} is a
+            # [width, k, D] compare-reduce on device (D ≤ max_degree)
+            self.cumw = jax.device_put(np.cumsum(wtab, axis=1))
+            self.wtab = jax.device_put(wtab)
+        # weight-proportional root draws (host sample_node parity): a
+        # uint32-quantized CDF, binary-searched on device — over all nodes,
+        # or over roots_pool's members when a pool restricts the draw.
+        # Integer quantization keeps adjacent cum values exact where f32
+        # cumsum over >1e6 nodes would swallow small weights.
+        wn = np.concatenate(
+            [np.asarray(s.node_weights, dtype=np.float64) for s in graph.shards]
+        )
+        pool_rows = None
+        if roots_pool is not None:
+            pool_rows = graph.lookup_rows(
+                np.asarray(roots_pool, dtype=np.uint64)
+            )
+            if np.any(pool_rows < 0):
+                raise ValueError("roots_pool contains unknown node ids")
+            wn = wn[pool_rows]
+        self.node_cdf = None
+        if wn.size and not np.all(wn == wn[0]):
+            cum = np.cumsum(wn)
+            if cum[-1] <= 0:
+                raise ValueError("root node weights sum to zero")
+            self.node_cdf = jax.device_put(
+                np.floor(cum / cum[-1] * np.float64(2**32 - 1)).astype(
+                    np.uint32
+                )
+            )
         # int32 view of the u64 id space for root_idx (same truncation the
         # host flows apply); index 0 (padding) maps to -1
         node_id = np.full(n + 1, -1, dtype=np.int32)
         node_id[1:] = ids.astype(np.int64).astype(np.int32)
         self.node_id = jax.device_put(node_id)
-        if roots_pool is not None:
-            pool = graph.lookup_rows(np.asarray(roots_pool, dtype=np.uint64))
-            if np.any(pool < 0):
-                raise ValueError("roots_pool contains unknown node ids")
-            self.roots = jax.device_put(pool.astype(np.int32) + 1)
-        else:
-            self.roots = None
+        self.roots = (
+            jax.device_put(pool_rows.astype(np.int32) + 1)
+            if pool_rows is not None
+            else None
+        )
         self.num_nodes = n
         if label_feature is not None:
             from euler_tpu.estimator.feature_cache import DeviceFeatureCache
@@ -143,18 +172,16 @@ class DeviceSageFlow:
         else:
             self.label_table = None
 
-    @property
-    def edges_per_step(self) -> int:
-        e, width = 0, self.batch_size
-        for k in self.fanouts:
-            e += width * k
-            width *= k
-        return e
-
     def sample(self, key) -> MiniBatch:
         """key → lean MiniBatch, jit-traceable (call inside the train step)."""
         keys = jax.random.split(key, 1 + len(self.fanouts))
-        if self.roots is not None:
+        if self.node_cdf is not None:
+            # weight-proportional draw over the pool (or all nodes)
+            r = jax.random.bits(keys[0], (self.batch_size,), dtype=jnp.uint32)
+            pick = jnp.searchsorted(self.node_cdf, r, side="right")
+            pick = jnp.minimum(pick, len(self.node_cdf) - 1).astype(jnp.int32)
+            cur = self.roots[pick] if self.roots is not None else pick + 1
+        elif self.roots is not None:
             pick = jax.random.randint(
                 keys[0], (self.batch_size,), 0, len(self.roots)
             )
@@ -169,16 +196,28 @@ class DeviceSageFlow:
         for k, hk in zip(self.fanouts, keys[1:]):
             deg = self.deg[cur]  # [width]
             u = jax.random.uniform(hk, (width, k))
-            idx = jnp.minimum(
-                (u * deg[:, None]).astype(jnp.int32),
-                jnp.maximum(deg[:, None] - 1, 0),
-            )
+            if self.unit_w:
+                idx = (u * deg[:, None]).astype(jnp.int32)
+                ew = None
+            else:
+                cw = self.cumw[cur]  # [width, D]
+                scaled = u * cw[:, -1][:, None]
+                idx = (cw[:, None, :] <= scaled[:, :, None]).sum(axis=-1)
+            idx = jnp.minimum(idx, jnp.maximum(deg[:, None] - 1, 0))
             nbr = jnp.where(
                 deg[:, None] > 0, self.adj[cur[:, None], idx], 0
             ).reshape(-1)
+            if not self.unit_w:
+                # weighted-lean wire parity: bf16 weights ride the batch
+                # (zeroed on padded slots via wtab's zero rows)
+                ew = (
+                    jnp.take_along_axis(self.wtab[cur], idx, axis=1)
+                    .reshape(-1)
+                    .astype(jnp.bfloat16)
+                )
             blocks.append(
                 Block(
-                    edge_src=None, edge_dst=None, edge_w=None, mask=None,
+                    edge_src=None, edge_dst=None, edge_w=ew, mask=None,
                     n_src=width * k, n_dst=width, grid=k,
                 )
             )

@@ -1,11 +1,12 @@
 #!/usr/bin/env bash
 # Background watcher: probe the TPU tunnel on a loop; the moment it is
-# alive, run the full measurement suite (tpu_suite.sh) and exit.
-# Logs to $LOG (default /tmp/etpu_tpu_watch.log).
+# alive, run the measurement suite ($SUITE, default tpu_suite.sh) and
+# exit. Logs to $LOG (default /tmp/etpu_tpu_watch.log).
 set -u
 cd "$(dirname "$0")/../.."
 LOG="${LOG:-/tmp/etpu_tpu_watch.log}"
 OUT="${OUT:-/tmp/etpu_tpu_suite}"
+SUITE="${SUITE:-euler_tpu/tools/tpu_suite.sh}"
 MAX_TRIES="${MAX_TRIES:-40}"
 SLEEP="${SLEEP:-900}"
 for i in $(seq 1 "$MAX_TRIES"); do
@@ -13,8 +14,8 @@ for i in $(seq 1 "$MAX_TRIES"); do
   probe=$(timeout 120 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
   echo "[$ts] probe $i/$MAX_TRIES: ${probe:-unreachable}" >> "$LOG"
   if [ "${probe:-}" = "tpu" ] || [ "${probe:-}" = "axon" ]; then
-    echo "[$ts] chip alive — running tpu_suite.sh" >> "$LOG"
-    bash euler_tpu/tools/tpu_suite.sh "$OUT" >> "$LOG" 2>&1
+    echo "[$ts] chip alive — running $SUITE" >> "$LOG"
+    bash "$SUITE" "$OUT" >> "$LOG" 2>&1
     echo "[done] suite rc=$? → $OUT" >> "$LOG"
     exit 0
   fi

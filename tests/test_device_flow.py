@@ -109,11 +109,95 @@ def test_roots_pool(graph):
     assert set(np.asarray(mb.feats[0]).tolist()) <= set(rows.tolist())
 
 
-def test_weighted_graph_rejected():
-    g = random_graph(num_nodes=50, out_degree=4, feat_dim=4, seed=0,
+def test_weighted_structure_matches_host_weighted_lean():
+    """Weighted graphs ship bf16 edge weights, leaf-for-leaf like the
+    host weighted-lean wire (sage.py _lean_w)."""
+    g = random_graph(num_nodes=100, out_degree=5, feat_dim=4, seed=1,
                      weighted=True)
-    with pytest.raises(ValueError, match="non-unit edge weights"):
-        DeviceSageFlow(g, fanouts=[2], batch_size=4)
+    host = SageDataFlow(
+        g, ["feat"], fanouts=[3, 2], label_feature="label",
+        feature_mode="rows", lean=True, rng=np.random.default_rng(0),
+    )
+    roots = g.sample_node(8, rng=np.random.default_rng(0))
+    host_mb = jax.device_put(host.query(roots))
+    flow = DeviceSageFlow(g, fanouts=[3, 2], batch_size=8,
+                          label_feature="label")
+    dev_mb = jax.jit(flow.sample)(jax.random.PRNGKey(0))
+    assert host.lean and host._lean_w, "fixture must exercise weighted-lean"
+    assert (jax.tree_util.tree_structure(host_mb)
+            == jax.tree_util.tree_structure(dev_mb))
+    assert dev_mb.blocks[0].edge_w.dtype == jnp.bfloat16
+    for a, b in zip(jax.tree_util.tree_leaves(host_mb),
+                    jax.tree_util.tree_leaves(dev_mb)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+
+
+def test_weighted_edge_distribution():
+    """Hop draws follow edge weights: a node whose out-edges carry weights
+    1 and 3 must be sampled ~1:3."""
+    g = random_graph(num_nodes=60, out_degree=2, feat_dim=4, seed=2)
+    store = g.shards[0]
+    # make every node's two out-edges carry weights 1 and 3
+    w = np.asarray(store.arrays["edge_weights"], dtype=np.float32)
+    w[0::2], w[1::2] = 1.0, 3.0
+    store.arrays["edge_weights"][:] = w
+    store.__init__(store.meta, store.arrays, store.part)  # rebuild samplers
+    flow = DeviceSageFlow(g, fanouts=[64], batch_size=60)
+    assert not flow.unit_w
+    fn = jax.jit(flow.sample)
+    hits = {}
+    ids = np.asarray(store.node_ids)
+    node = int(ids[0])
+    nbr, wfull, _, mask, _ = g.get_full_neighbor(np.array([node], np.uint64))
+    w_by_nbr = {int(a): float(b) for a, b in
+                zip(nbr[0][mask[0]], wfull[0][mask[0]])}
+    for t in range(20):
+        mb = fn(jax.random.PRNGKey(t))
+        roots = np.asarray(mb.feats[0])
+        hop = np.asarray(mb.feats[1]).reshape(60, 64)
+        for r, row in zip(roots, hop):
+            if int(ids[r - 1]) == node:
+                for x in row:
+                    hits[int(ids[x - 1])] = hits.get(int(ids[x - 1]), 0) + 1
+    total = sum(hits.values())
+    assert total >= 64
+    for nb, cnt in hits.items():
+        expect = w_by_nbr[nb] / sum(w_by_nbr.values())
+        assert abs(cnt / total - expect) < 0.15, (nb, cnt / total, expect)
+
+
+def test_weighted_root_distribution():
+    """Root draws follow node weights through the quantized CDF."""
+    g = random_graph(num_nodes=40, out_degree=3, feat_dim=4, seed=4)
+    store = g.shards[0]
+    nw = np.ones(40, dtype=np.float32)
+    nw[:4] = 10.0  # 4 hot nodes: 40/76 of the mass
+    store.arrays["node_weights"][:] = nw
+    store.node_weights = store.arrays["node_weights"]
+    flow = DeviceSageFlow(g, fanouts=[2], batch_size=256)
+    assert flow.node_cdf is not None
+    fn = jax.jit(flow.sample)
+    counts = np.zeros(41)
+    for t in range(20):
+        mb = fn(jax.random.PRNGKey(t))
+        np.add.at(counts, np.asarray(mb.feats[0]), 1)
+    hot = counts[1:5].sum() / counts.sum()
+    assert abs(hot - 40 / 76) < 0.08, hot
+    # a roots_pool restricting the draw keeps weight proportionality
+    # within the pool (rows 0..7: weights 10,10,10,10,1,1,1,1 → hot 40/44)
+    ids = np.asarray(store.node_ids)
+    pool_flow = DeviceSageFlow(
+        g, fanouts=[2], batch_size=256, roots_pool=ids[:8]
+    )
+    assert pool_flow.node_cdf is not None and len(pool_flow.node_cdf) == 8
+    fn = jax.jit(pool_flow.sample)
+    counts = np.zeros(41)
+    for t in range(20):
+        mb = fn(jax.random.PRNGKey(t))
+        np.add.at(counts, np.asarray(mb.feats[0]), 1)
+    assert counts[9:].sum() == 0, "draws escaped the pool"
+    hot = counts[1:5].sum() / counts.sum()
+    assert abs(hot - 40 / 44) < 0.05, hot
 
 
 def test_estimator_trains_and_is_deterministic(graph, tmp_path):
