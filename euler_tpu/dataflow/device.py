@@ -62,6 +62,7 @@ class DeviceSageFlow:
         edge_types=None,
         max_degree: int = 512,
         roots_pool: np.ndarray | None = None,
+        mesh=None,
     ):
         """roots_pool: optional node ids to sample roots from (e.g. a
         train split); default is every node. Root draws are proportional
@@ -72,9 +73,17 @@ class DeviceSageFlow:
         would bias sampling, so it is never done silently. The default
         (512) makes a hub-heavy power-law graph fail loudly instead of
         allocating an N×hub_degree table; raise it explicitly after
-        checking the memory math."""
+        checking the memory math.
+
+        mesh: a jax.sharding.Mesh for data-parallel training — sampled
+        batch leaves are sharding-constrained along the mesh's data axis
+        (each device materializes only its own batch slice; the staged
+        tables replicate), so one traced sample() drives every device.
+        Values are identical to the unsharded program for the same key.
+        """
         self.fanouts = [int(k) for k in fanouts]
         self.batch_size = int(batch_size)
+        self.mesh = mesh
         if not all(
             hasattr(s, "node_ids") and hasattr(s, "node_weights")
             for s in graph.shards
@@ -121,13 +130,11 @@ class DeviceSageFlow:
         self.adj = jax.device_put(adj)
         self.deg = jax.device_put(deg)
         self.unit_w = unit_w
-        if unit_w:
-            self.cumw = self.wtab = None
-        else:
-            # inverse-CDF tables: idx = #{t : cum[t] <= u·total} is a
-            # [width, k, D] compare-reduce on device (D ≤ max_degree)
-            self.cumw = jax.device_put(np.cumsum(wtab, axis=1))
-            self.wtab = jax.device_put(wtab)
+        # inverse-CDF table: idx = #{t : cum[t] <= u·total} is a
+        # [width, k, D] compare-reduce on device (D ≤ max_degree); the
+        # raw weights are recovered as adjacent cum differences, so only
+        # the cumulative table is staged
+        self.cumw = None if unit_w else jax.device_put(np.cumsum(wtab, axis=1))
         # weight-proportional root draws (host sample_node parity): a
         # uint32-quantized CDF, binary-searched on device — over all nodes,
         # or over roots_pool's members when a pool restricts the draw.
@@ -172,6 +179,21 @@ class DeviceSageFlow:
         else:
             self.label_table = None
 
+    def _dp(self, x):
+        """Constrain a batch-leading array to the mesh's data axis (same
+        divisibility rule as parallel.shard_batch); no-op without a mesh."""
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from euler_tpu.parallel import DATA_AXIS
+
+        nd = self.mesh.shape[DATA_AXIS]
+        spec = P(DATA_AXIS) if x.ndim >= 1 and x.shape[0] % nd == 0 else P()
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, spec)
+        )
+
     def sample(self, key) -> MiniBatch:
         """key → lean MiniBatch, jit-traceable (call inside the train step)."""
         keys = jax.random.split(key, 1 + len(self.fanouts))
@@ -190,6 +212,7 @@ class DeviceSageFlow:
             cur = jax.random.randint(
                 keys[0], (self.batch_size,), 1, self.num_nodes + 1
             )
+        cur = self._dp(cur)
         feats = [cur]
         blocks = []
         width = self.batch_size
@@ -207,14 +230,18 @@ class DeviceSageFlow:
             nbr = jnp.where(
                 deg[:, None] > 0, self.adj[cur[:, None], idx], 0
             ).reshape(-1)
+            nbr = self._dp(nbr)
             if not self.unit_w:
-                # weighted-lean wire parity: bf16 weights ride the batch
-                # (zeroed on padded slots via wtab's zero rows)
-                ew = (
-                    jnp.take_along_axis(self.wtab[cur], idx, axis=1)
-                    .reshape(-1)
-                    .astype(jnp.bfloat16)
+                # weighted-lean wire parity: bf16 weights ride the batch.
+                # w[idx] = cum[idx] - cum[idx-1]; zero on padded slots
+                # (their cum rows are all zero)
+                hi = jnp.take_along_axis(cw, idx, axis=1)
+                lo = jnp.where(
+                    idx > 0,
+                    jnp.take_along_axis(cw, jnp.maximum(idx - 1, 0), axis=1),
+                    0.0,
                 )
+                ew = self._dp((hi - lo).reshape(-1).astype(jnp.bfloat16))
             blocks.append(
                 Block(
                     edge_src=None, edge_dst=None, edge_w=ew, mask=None,
@@ -227,11 +254,13 @@ class DeviceSageFlow:
         labels = (
             self.label_table[feats[0]] if self.label_table is not None else None
         )
+        if labels is not None:
+            labels = self._dp(labels)
         return MiniBatch(
             feats=tuple(feats),
             masks=None,
             blocks=tuple(blocks),
-            root_idx=self.node_id[feats[0]],
+            root_idx=self._dp(self.node_id[feats[0]]),
             labels=labels,
             hop_ids=None,
         )

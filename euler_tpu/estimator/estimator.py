@@ -106,11 +106,17 @@ class Estimator:
         # device-flow sampling keys: folded per GLOBAL step, so the batch
         # sequence is deterministic and independent of steps_per_call
         self._flow_key = jax.random.PRNGKey(self.cfg.seed + 2)
-        if self._device_flow is not None and mesh is not None:
-            raise NotImplementedError(
-                "device-flow batches under a mesh are not wired yet — "
-                "use a host batch_fn for multi-device training"
-            )
+        if self._device_flow is not None:
+            fm = getattr(self._device_flow, "mesh", None)
+            if (fm is None) != (mesh is None) or (
+                mesh is not None and fm != mesh
+            ):
+                raise ValueError(
+                    "device-flow training needs the Estimator and the flow "
+                    "to share one mesh (DeviceSageFlow(..., mesh=mesh)) so "
+                    "sampled batches are data-axis sharded; got flow mesh "
+                    f"{fm} vs estimator mesh {mesh}"
+                )
         self._jit_train = None
         self._jit_train_scan = None
         self._jit_eval = None

@@ -228,6 +228,65 @@ def test_estimator_trains_and_is_deterministic(graph, tmp_path):
     np.testing.assert_allclose(np.array(a), np.array(c), rtol=1e-4)
 
 
+def test_mesh_data_parallel_loss_parity(graph, tmp_path):
+    """Device-flow training under an 8-device data mesh: sampled batches
+    are sharding-constrained along the data axis, and the loss sequence
+    is identical to the single-device run (same keys → same values)."""
+    from euler_tpu.parallel import make_mesh
+
+    def run(mesh):
+        flow = DeviceSageFlow(
+            graph, fanouts=[4, 3], batch_size=16, label_feature="label",
+            mesh=mesh,
+        )
+        est = Estimator(
+            GraphSAGESupervised(dims=[16, 16], label_dim=2),
+            flow,
+            EstimatorConfig(
+                model_dir=str(tmp_path / f"mesh{mesh is not None}"),
+                learning_rate=0.05, log_steps=10**9, steps_per_call=4,
+            ),
+            mesh=mesh,
+            feature_cache=DeviceFeatureCache(graph, ["feat"]),
+        )
+        return est.train(total_steps=8, log=False, save=False)
+
+    sharded = run(make_mesh(8))
+    single = run(None)
+    np.testing.assert_allclose(np.array(sharded), np.array(single),
+                               rtol=2e-4)
+
+
+def test_mesh_mismatch_rejected(graph, tmp_path):
+    from euler_tpu.parallel import make_mesh
+
+    flow = DeviceSageFlow(graph, fanouts=[4], batch_size=16,
+                          label_feature="label")
+    with pytest.raises(ValueError, match="share one mesh"):
+        Estimator(
+            GraphSAGESupervised(dims=[16], label_dim=2), flow,
+            EstimatorConfig(model_dir=str(tmp_path / "mm")),
+            mesh=make_mesh(8),
+        )
+    # the reverse direction is guarded too: a mesh-built flow cannot feed
+    # a meshless Estimator (its sharding constraints would misplace)
+    mflow = DeviceSageFlow(graph, fanouts=[4], batch_size=16,
+                           label_feature="label", mesh=make_mesh(8))
+    with pytest.raises(ValueError, match="share one mesh"):
+        Estimator(
+            GraphSAGESupervised(dims=[16], label_dim=2), mflow,
+            EstimatorConfig(model_dir=str(tmp_path / "mm2")),
+        )
+    # equal-but-distinct meshes are accepted (equality, not identity)
+    Estimator(
+        GraphSAGESupervised(dims=[16], label_dim=2),
+        DeviceSageFlow(graph, fanouts=[4], batch_size=16,
+                       label_feature="label", mesh=make_mesh(8)),
+        EstimatorConfig(model_dir=str(tmp_path / "mm3")),
+        mesh=make_mesh(8),
+    )
+
+
 def test_remainder_steps(graph, tmp_path):
     """total_steps not a multiple of steps_per_call exercises the
     single-step remainder path with sliced flow keys."""
