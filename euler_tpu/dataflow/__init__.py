@@ -1,5 +1,9 @@
 from euler_tpu.dataflow.base import Block, DataFlow, MiniBatch, fanout_block  # noqa: F401
-from euler_tpu.dataflow.device import DeviceSageFlow  # noqa: F401
+from euler_tpu.dataflow.device import (  # noqa: F401
+    DeviceGraphTables,
+    DeviceSageFlow,
+    DeviceWalkFlow,
+)
 from euler_tpu.dataflow.sage import FullNeighborDataFlow, SageDataFlow  # noqa: F401
 from euler_tpu.dataflow.walk import gen_pair  # noqa: F401
 from euler_tpu.dataflow.whole import (  # noqa: F401

@@ -1,25 +1,26 @@
-"""Fully on-device GraphSAGE batch sampling.
+"""Fully on-device graph sampling (GraphSAGE fanouts + random walks).
 
-The host flows (sage.py) sample subgraphs on the CPU and ship int32
-feature rows over PCIe/network every step — the lean wire minimizes the
-bytes, but a tunneled or remote device still pays per-dispatch transfer
-for ~10^5 rows/step. This module removes the wire entirely: the padded
-adjacency lives in HBM next to the feature cache, and every step of the
-scanned train loop *traces* root sampling + multi-hop fanout as XLA ops.
+The host flows (sage.py, walk.py) sample subgraphs and walks on the CPU
+and ship int32 feature rows over PCIe/network every step — the lean wire
+minimizes the bytes, but a tunneled or remote device still pays
+per-dispatch transfer for ~10^5 rows/step. This module removes the wire
+entirely: the padded adjacency lives in HBM next to the feature cache,
+and every step of the scanned train loop *traces* root sampling +
+multi-hop fanout (or walk + skip-gram pair generation) as XLA ops.
 Per-step host→device traffic is zero; the only inputs are PRNG keys.
 
-This is the TPU-first answer to the reference's sample_fanout kernel
-(euler/core/kernels/sample_fanout_op.cc and the TF custom op in
-tf_euler/python/euler_ops/neighbor_ops.py): instead of a host-side C++
-sampler feeding the accelerator, the sampler IS accelerator code — a
-[N+1, D] int32 gather plus vectorized uniform draws, fused by XLA into
-the same program as the model. Weighted graphs are first-class: edge
-draws invert a per-row cumulative-weight CDF with a [W, k, D] compare-
-reduce (pure VPU work; D is the guarded max degree), and weighted root
-draws binary-search a uint32-quantized node-weight CDF — the same
-weighted-with-replacement distribution the host samplers and the C++
-engine's alias tables draw from (graph_engine.cc `AliasTable`). Batches
-from a weighted graph carry bf16 edge weights, matching the host
+This is the TPU-first answer to the reference's sample_fanout and
+random_walk kernels (euler/core/kernels/sample_fanout_op.cc,
+random_walk_op.cc, and the TF custom ops in tf_euler/python/euler_ops):
+instead of a host-side C++ sampler feeding the accelerator, the sampler
+IS accelerator code — a [N+1, D] int32 gather plus vectorized uniform
+draws, fused by XLA into the same program as the model. Weighted graphs
+are first-class: edge draws invert a per-row cumulative-weight CDF with
+a [W, k, D] compare-reduce (pure VPU work; D is the guarded max degree),
+and weighted root draws binary-search a uint32-quantized node-weight CDF
+— the same weighted-with-replacement distribution the host samplers and
+the C++ engine's alias tables draw from (graph_engine.cc `AliasTable`).
+Batches from a weighted graph carry bf16 edge weights, matching the host
 weighted-lean wire (sage.py `_lean_w`) leaf-for-leaf.
 
 Memory: the padded adjacency costs (N+1)·Dmax·4 bytes of HBM (row+1
@@ -41,14 +42,14 @@ from .base import Block, MiniBatch
 _STAGE_CHUNK = 16384
 
 
-class DeviceSageFlow:
-    """HBM-resident adjacency + traced fanout sampling → lean MiniBatch.
+class DeviceGraphTables:
+    """HBM-resident graph tables + traced draw primitives.
 
-    Pass an instance as an Estimator's `batch_fn`: the Estimator detects
-    `is_device_flow` and generates batches inside the jitted train step
-    from per-step PRNG keys (estimator.py `_train_step_scan`). The batch
-    pytree is identical to what a lean host `SageDataFlow` ships after
-    device_put, so models, hydration, and the feature cache are shared.
+    Stages (once, host-side) the padded adjacency, degree vector,
+    cumulative edge-weight CDF (weighted graphs only), quantized
+    node-weight CDF (non-uniform node weights only), and the id↔row
+    maps. Subclasses compose `_draw_roots` / `_draw_neighbors` into
+    batch shapes; all draws are jit-traceable.
     """
 
     is_device_flow = True
@@ -56,22 +57,22 @@ class DeviceSageFlow:
     def __init__(
         self,
         graph,
-        fanouts,
-        batch_size: int,
-        label_feature: str | None = None,
         edge_types=None,
         max_degree: int = 512,
         roots_pool: np.ndarray | None = None,
+        root_node_type: int = -1,
         mesh=None,
     ):
         """roots_pool: optional node ids to sample roots from (e.g. a
-        train split); default is every node. Root draws are proportional
+        train split); root_node_type restricts root draws to one node
+        type instead (host sample_node(node_type) parity; ignored when a
+        pool is given); default is every node. Root draws are proportional
         to node weights either way (uniform when weights are constant —
-        host sample_node parity). max_degree is a guard on the
-        staged adjacency width ((N+1)·Dmax·4 bytes of HBM): construction
-        raises when the graph's true max degree exceeds it — truncation
-        would bias sampling, so it is never done silently. The default
-        (512) makes a hub-heavy power-law graph fail loudly instead of
+        host sample_node parity). max_degree is a guard on the staged
+        adjacency width ((N+1)·Dmax·4 bytes of HBM): construction raises
+        when the graph's true max degree exceeds it — truncation would
+        bias sampling, so it is never done silently. The default (512)
+        makes a hub-heavy power-law graph fail loudly instead of
         allocating an N×hub_degree table; raise it explicitly after
         checking the memory math.
 
@@ -81,16 +82,14 @@ class DeviceSageFlow:
         tables replicate), so one traced sample() drives every device.
         Values are identical to the unsharded program for the same key.
         """
-        self.fanouts = [int(k) for k in fanouts]
-        self.batch_size = int(batch_size)
         self.mesh = mesh
         if not all(
             hasattr(s, "node_ids") and hasattr(s, "node_weights")
             for s in graph.shards
         ):
             raise ValueError(
-                "DeviceSageFlow stages the full adjacency host-side and "
-                "needs local shards (remote graphs keep the host flows)"
+                "device flows stage the full adjacency host-side and "
+                "need local shards (remote graphs keep the host flows)"
             )
         ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
         n = len(ids)
@@ -100,7 +99,7 @@ class DeviceSageFlow:
                 f"graph max degree {dmax} exceeds max_degree={max_degree}; "
                 "the staged adjacency would cost (N+1)*"
                 f"{dmax}*4 bytes — raise the cap explicitly or use the "
-                "host SageDataFlow"
+                "host flows"
             )
         adj = np.zeros((n + 1, dmax), dtype=np.int32)
         deg = np.zeros(n + 1, dtype=np.int32)
@@ -130,11 +129,11 @@ class DeviceSageFlow:
         self.adj = jax.device_put(adj)
         self.deg = jax.device_put(deg)
         self.unit_w = unit_w
-        # inverse-CDF table: idx = #{t : cum[t] <= u·total} is a
-        # [width, k, D] compare-reduce on device (D ≤ max_degree); the
-        # raw weights are recovered as adjacent cum differences, so only
-        # the cumulative table is staged
-        self.cumw = None if unit_w else jax.device_put(np.cumsum(wtab, axis=1))
+        # weighted graphs stage the RAW weight rows (exact values for
+        # edge_w and bias math); the per-row CDF is a cheap [W, D] cumsum
+        # on the gathered rows at draw time — one table, no f32
+        # cancellation from storing cumulative sums
+        self.wtab = None if unit_w else jax.device_put(wtab)
         # weight-proportional root draws (host sample_node parity): a
         # uint32-quantized CDF, binary-searched on device — over all nodes,
         # or over roots_pool's members when a pool restricts the draw.
@@ -151,6 +150,16 @@ class DeviceSageFlow:
             if np.any(pool_rows < 0):
                 raise ValueError("roots_pool contains unknown node ids")
             wn = wn[pool_rows]
+        elif root_node_type >= 0:
+            nt = np.concatenate(
+                [np.asarray(s.node_types) for s in graph.shards]
+            )
+            pool_rows = np.nonzero(nt == root_node_type)[0].astype(np.int64)
+            if not len(pool_rows):
+                raise ValueError(
+                    f"no nodes of type {root_node_type} to sample roots from"
+                )
+            wn = wn[pool_rows]
         self.node_cdf = None
         if wn.size and not np.all(wn == wn[0]):
             cum = np.cumsum(wn)
@@ -161,8 +170,8 @@ class DeviceSageFlow:
                     np.uint32
                 )
             )
-        # int32 view of the u64 id space for root_idx (same truncation the
-        # host flows apply); index 0 (padding) maps to -1
+        # int32 view of the u64 id space (host flows apply the same
+        # truncation); index 0 (padding) maps to -1
         node_id = np.full(n + 1, -1, dtype=np.int32)
         node_id[1:] = ids.astype(np.int64).astype(np.int32)
         self.node_id = jax.device_put(node_id)
@@ -172,12 +181,9 @@ class DeviceSageFlow:
             else None
         )
         self.num_nodes = n
-        if label_feature is not None:
-            from euler_tpu.estimator.feature_cache import DeviceFeatureCache
+        self.max_deg = dmax
 
-            self.label_table = DeviceFeatureCache(graph, [label_feature]).table
-        else:
-            self.label_table = None
+    # -- traced draw primitives ------------------------------------------
 
     def _dp(self, x):
         """Constrain a batch-leading array to the mesh's data axis (same
@@ -194,54 +200,96 @@ class DeviceSageFlow:
             x, NamedSharding(self.mesh, spec)
         )
 
+    def _draw_roots(self, key, count: int):
+        """[count] root draws in row+1 space, weight-proportional."""
+        if self.node_cdf is not None:
+            r = jax.random.bits(key, (count,), dtype=jnp.uint32)
+            pick = jnp.searchsorted(self.node_cdf, r, side="right")
+            pick = jnp.minimum(pick, len(self.node_cdf) - 1).astype(jnp.int32)
+            return self.roots[pick] if self.roots is not None else pick + 1
+        if self.roots is not None:
+            pick = jax.random.randint(key, (count,), 0, len(self.roots))
+            return self.roots[pick]
+        return jax.random.randint(key, (count,), 1, self.num_nodes + 1)
+
+    def _draw_neighbors(self, cur, key, k: int):
+        """[W] rows → ([W·k] neighbor rows, [W·k] bf16 weights or None).
+
+        Uniform graphs draw a slot index directly; weighted graphs invert
+        the per-row cumulative CDF. Padding rows (0) yield padding.
+        """
+        width = cur.shape[0]
+        deg = self.deg[cur]
+        u = jax.random.uniform(key, (width, k))
+        if self.unit_w:
+            idx = (u * deg[:, None]).astype(jnp.int32)
+            ew = None
+        else:
+            w = self.wtab[cur]  # [W, D] exact weights
+            cw = jnp.cumsum(w, axis=1)
+            scaled = u * cw[:, -1][:, None]
+            idx = (cw[:, None, :] <= scaled[:, :, None]).sum(axis=-1)
+        idx = jnp.minimum(idx, jnp.maximum(deg[:, None] - 1, 0))
+        nbr = jnp.where(
+            deg[:, None] > 0, self.adj[cur[:, None], idx], 0
+        ).reshape(-1)
+        if not self.unit_w:
+            # exact staged weight of the drawn edge (zero on padded slots)
+            ew = (
+                jnp.take_along_axis(w, idx, axis=1)
+                .reshape(-1)
+                .astype(jnp.bfloat16)
+            )
+        return nbr, ew
+
+
+class DeviceSageFlow(DeviceGraphTables):
+    """HBM-resident adjacency + traced fanout sampling → lean MiniBatch.
+
+    Pass an instance as an Estimator's `batch_fn`: the Estimator detects
+    `is_device_flow` and generates batches inside the jitted train step
+    from per-step PRNG keys (estimator.py `_train_step_scan`). The batch
+    pytree is identical to what a lean host `SageDataFlow` ships after
+    device_put, so models, hydration, and the feature cache are shared.
+    """
+
+    def __init__(
+        self,
+        graph,
+        fanouts,
+        batch_size: int,
+        label_feature: str | None = None,
+        edge_types=None,
+        max_degree: int = 512,
+        roots_pool: np.ndarray | None = None,
+        root_node_type: int = -1,
+        mesh=None,
+    ):
+        super().__init__(
+            graph, edge_types, max_degree, roots_pool, root_node_type, mesh
+        )
+        self.fanouts = [int(k) for k in fanouts]
+        self.batch_size = int(batch_size)
+        if label_feature is not None:
+            from euler_tpu.estimator.feature_cache import DeviceFeatureCache
+
+            self.label_table = DeviceFeatureCache(graph, [label_feature]).table
+        else:
+            self.label_table = None
+
     def sample(self, key) -> MiniBatch:
         """key → lean MiniBatch, jit-traceable (call inside the train step)."""
         keys = jax.random.split(key, 1 + len(self.fanouts))
-        if self.node_cdf is not None:
-            # weight-proportional draw over the pool (or all nodes)
-            r = jax.random.bits(keys[0], (self.batch_size,), dtype=jnp.uint32)
-            pick = jnp.searchsorted(self.node_cdf, r, side="right")
-            pick = jnp.minimum(pick, len(self.node_cdf) - 1).astype(jnp.int32)
-            cur = self.roots[pick] if self.roots is not None else pick + 1
-        elif self.roots is not None:
-            pick = jax.random.randint(
-                keys[0], (self.batch_size,), 0, len(self.roots)
-            )
-            cur = self.roots[pick]
-        else:
-            cur = jax.random.randint(
-                keys[0], (self.batch_size,), 1, self.num_nodes + 1
-            )
-        cur = self._dp(cur)
+        cur = self._dp(self._draw_roots(keys[0], self.batch_size))
         feats = [cur]
         blocks = []
         width = self.batch_size
         for k, hk in zip(self.fanouts, keys[1:]):
-            deg = self.deg[cur]  # [width]
-            u = jax.random.uniform(hk, (width, k))
-            if self.unit_w:
-                idx = (u * deg[:, None]).astype(jnp.int32)
-                ew = None
-            else:
-                cw = self.cumw[cur]  # [width, D]
-                scaled = u * cw[:, -1][:, None]
-                idx = (cw[:, None, :] <= scaled[:, :, None]).sum(axis=-1)
-            idx = jnp.minimum(idx, jnp.maximum(deg[:, None] - 1, 0))
-            nbr = jnp.where(
-                deg[:, None] > 0, self.adj[cur[:, None], idx], 0
-            ).reshape(-1)
+            nbr, ew = self._draw_neighbors(cur, hk, k)
             nbr = self._dp(nbr)
-            if not self.unit_w:
-                # weighted-lean wire parity: bf16 weights ride the batch.
-                # w[idx] = cum[idx] - cum[idx-1]; zero on padded slots
-                # (their cum rows are all zero)
-                hi = jnp.take_along_axis(cw, idx, axis=1)
-                lo = jnp.where(
-                    idx > 0,
-                    jnp.take_along_axis(cw, jnp.maximum(idx - 1, 0), axis=1),
-                    0.0,
-                )
-                ew = self._dp((hi - lo).reshape(-1).astype(jnp.bfloat16))
+            if ew is not None:
+                # weighted-lean wire parity: bf16 weights ride the batch
+                ew = self._dp(ew)
             blocks.append(
                 Block(
                     edge_src=None, edge_dst=None, edge_w=ew, mask=None,
@@ -268,5 +316,140 @@ class DeviceSageFlow:
     def __call__(self):
         raise TypeError(
             "DeviceSageFlow is not a host batch_fn; pass it to an Estimator "
+            "(detected via is_device_flow) or call .sample(key) inside jit"
+        )
+
+
+class DeviceWalkFlow(DeviceGraphTables):
+    """On-device random walks + skip-gram pairs for DeepWalk/node2vec.
+
+    Replaces the host walk pipeline (graph.random_walk → dataflow.walk
+    gen_pair → negative draws, models/embedding_models.deepwalk_batches)
+    with traced XLA ops: the walk is a length-L chain of single-neighbor
+    draws against the HBM adjacency, the sliding-window pair extraction
+    is a static column gather, and negatives ride the same node CDF.
+    `sample(key)` returns the exact dict batch `SkipGramModel` consumes
+    (src/pos int32 ids, negs [P, num_negs], mask) with identical padding
+    semantics (-1 ids on dead-walk slots are excluded by the mask).
+
+    node2vec bias (p/q ≠ 1, random_walk_op.cc:27-90): each step biases
+    the current node's weight row by 1/p toward the previous node, 1 for
+    neighbors of the previous node, 1/q elsewhere — the membership test
+    is a [W, D, D] compare against prev's adjacency row, so the biased
+    path is gated to max degree ≤ 64 (guarded at construction).
+    """
+
+    def __init__(
+        self,
+        graph,
+        batch_size: int,
+        walk_len: int = 5,
+        window: int = 2,
+        num_negs: int = 5,
+        p: float = 1.0,
+        q: float = 1.0,
+        edge_types=None,
+        max_degree: int = 512,
+        roots_pool: np.ndarray | None = None,
+        root_node_type: int = -1,
+        mesh=None,
+    ):
+        super().__init__(
+            graph, edge_types, max_degree, roots_pool, root_node_type, mesh
+        )
+        self.batch_size = int(batch_size)
+        self.walk_len = int(walk_len)
+        self.num_negs = int(num_negs)
+        self.p, self.q = float(p), float(q)
+        self.biased = not (p == 1.0 and q == 1.0)
+        if self.biased and self.max_deg > 64:
+            raise ValueError(
+                f"node2vec bias needs a [W, D, D] membership test; max "
+                f"degree {self.max_deg} > 64 makes that table too wide — "
+                "use the host random_walk for this graph"
+            )
+        # static sliding-window column indices (walk.py gen_pair parity):
+        # for each offset, source columns [lo, hi) pair with context
+        # columns [lo+off, hi+off); padded tail slots point at a dead
+        # column marked invalid
+        length = self.walk_len + 1
+        src_cols, ctx_cols, valid = [], [], []
+        for off in range(-window, window + 1):
+            if off == 0:
+                continue
+            lo, hi = max(0, -off), min(length, length - off)
+            cols = np.arange(length)
+            s = np.where(cols < hi - lo, cols + lo, 0)
+            c = np.where(cols < hi - lo, cols + lo + off, 0)
+            src_cols.append(s)
+            ctx_cols.append(c)
+            valid.append(cols < hi - lo)
+        self._src_cols = np.concatenate(src_cols)
+        self._ctx_cols = np.concatenate(ctx_cols)
+        self._col_valid = np.concatenate(valid)
+        self.pairs_per_walk = len(self._src_cols)
+
+    def _walk_step(self, cur, prev, key):
+        """One biased transition (p/q): weight row × node2vec bias, then
+        the same inverse-CDF draw as the unbiased path."""
+        width = cur.shape[0]
+        nbr_rows = self.adj[cur]  # [W, D]
+        deg = self.deg[cur]
+        if self.unit_w:
+            w = (nbr_rows > 0).astype(jnp.float32)
+        else:
+            w = self.wtab[cur]
+        # bias: 1/p back to prev, 1 if adjacent to prev, 1/q otherwise
+        prev_nbrs = self.adj[prev]  # [W, D]
+        is_back = nbr_rows == prev[:, None]
+        near = (
+            (nbr_rows[:, :, None] == prev_nbrs[:, None, :])
+            & (prev_nbrs[:, None, :] > 0)
+        ).any(axis=-1)
+        bias = jnp.where(
+            is_back, 1.0 / self.p, jnp.where(near, 1.0, 1.0 / self.q)
+        )
+        bias = jnp.where((prev > 0)[:, None], bias, 1.0)
+        bw = w * bias * (nbr_rows > 0)
+        cum = jnp.cumsum(bw, axis=1)
+        u = jax.random.uniform(key, (width, 1)) * cum[:, -1][:, None]
+        idx = (cum <= u).sum(axis=1)
+        idx = jnp.minimum(idx, jnp.maximum(deg - 1, 0))
+        alive = (deg > 0) & (cum[:, -1] > 0)
+        return jnp.where(alive, nbr_rows[jnp.arange(width), idx], 0)
+
+    def sample(self, key) -> dict:
+        """key → SkipGramModel batch dict, jit-traceable."""
+        kroot, kneg, kwalk = jax.random.split(key, 3)
+        cur = self._dp(self._draw_roots(kroot, self.batch_size))
+        walk = [cur]
+        prev = jnp.zeros_like(cur)
+        for sk in jax.random.split(kwalk, self.walk_len):
+            if self.biased:
+                nxt = self._walk_step(cur, prev, sk)
+            else:
+                nxt, _ = self._draw_neighbors(cur, sk, 1)
+            prev, cur = cur, self._dp(nxt)
+            walk.append(cur)
+        walks = jnp.stack(walk, axis=1)  # [B, L+1] rows (0 = dead)
+        src = walks[:, self._src_cols] * self._col_valid  # [B, M]
+        ctx = walks[:, self._ctx_cols] * self._col_valid
+        mask = (src > 0) & (ctx > 0)
+        negs = self._draw_roots(
+            kneg, self.batch_size * self.pairs_per_walk * self.num_negs
+        )
+        to_id = lambda r: self.node_id[r]  # noqa: E731  (-1 on padding)
+        return {
+            "src": self._dp(to_id(src.reshape(-1))),
+            "pos": self._dp(to_id(ctx.reshape(-1))),
+            "negs": self._dp(
+                to_id(negs).reshape(-1, self.num_negs)
+            ),
+            "mask": self._dp(mask.reshape(-1)),
+        }
+
+    def __call__(self):
+        raise TypeError(
+            "DeviceWalkFlow is not a host batch_fn; pass it to an Estimator "
             "(detected via is_device_flow) or call .sample(key) inside jit"
         )

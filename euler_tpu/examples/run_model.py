@@ -77,6 +77,10 @@ def build_parser():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--data-parallel", type=int, default=0,
                     help="devices for a data-parallel mesh (0 = single)")
+    ap.add_argument("--device-flow", action="store_true",
+                    help="sample batches ON the accelerator (HBM-resident "
+                         "adjacency, zero per-step wire bytes) — conv "
+                         "models and deepwalk/node2vec, local graphs only")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize conv layers on backward "
                          "(jax.checkpoint) — trades FLOPs for HBM on "
@@ -157,15 +161,26 @@ def main(argv=None):
             num_nodes=max_id, dim=args.embedding_dim,
             shared_context=(name == "line"),
         )
-        bf = (
-            line_batches(graph, args.batch_size, args.num_negs, rng=rng)
-            if name == "line"
-            else deepwalk_batches(
+        if args.device_flow and name != "line":
+            from euler_tpu.dataflow import DeviceWalkFlow
+
+            bf = DeviceWalkFlow(
                 graph, args.batch_size, args.walk_len, args.window,
                 args.num_negs, p=args.p if name == "node2vec" else 1.0,
-                q=args.q if name == "node2vec" else 1.0, rng=rng,
+                q=args.q if name == "node2vec" else 1.0, mesh=mesh,
             )
-        )
+        else:
+            if args.device_flow:
+                print("# --device-flow: line samples edges; host path kept")
+            bf = (
+                line_batches(graph, args.batch_size, args.num_negs, rng=rng)
+                if name == "line"
+                else deepwalk_batches(
+                    graph, args.batch_size, args.walk_len, args.window,
+                    args.num_negs, p=args.p if name == "node2vec" else 1.0,
+                    q=args.q if name == "node2vec" else 1.0, rng=rng,
+                )
+            )
         est = Estimator(model, bf, cfg, mesh=mesh)
     elif name in GRAPH_CLF:
         from euler_tpu.dataflow import WholeGraphDataFlow, graph_label_batches
@@ -276,10 +291,26 @@ def main(argv=None):
             conv=CONV_MODELS[name], dims=dims, label_dim=label_dim,
             conv_kwargs=conv_kwargs, remat=args.remat,
         )
-        est = Estimator(
-            model, node_batches(graph, flow, args.batch_size, 0, rng=rng),
-            cfg, mesh=mesh,
-        )
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceSageFlow
+            from euler_tpu.estimator import DeviceFeatureCache
+
+            est = Estimator(
+                model,
+                DeviceSageFlow(
+                    graph, fanouts=args.fanouts[: args.layers],
+                    batch_size=args.batch_size, label_feature="label",
+                    root_node_type=0,  # node_batches(..., 0) parity
+                    mesh=mesh,
+                ),
+                cfg, mesh=mesh,
+                feature_cache=DeviceFeatureCache(graph, [feature]),
+            )
+        else:
+            est = Estimator(
+                model, node_batches(graph, flow, args.batch_size, 0, rng=rng),
+                cfg, mesh=mesh,
+            )
     else:
         raise SystemExit(f"unknown model {name!r}")
 

@@ -109,6 +109,29 @@ def test_roots_pool(graph):
     assert set(np.asarray(mb.feats[0]).tolist()) <= set(rows.tolist())
 
 
+def test_root_node_type_restricts_draws():
+    """root_node_type draws roots only from that type (sample_node(t)
+    parity on heterogeneous graphs)."""
+    from euler_tpu.graph import Graph
+
+    nodes = [
+        {"id": i, "type": i % 2, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense", "value": [1.0]}]}
+        for i in range(20)
+    ]
+    edges = [
+        {"src": i, "dst": (i + 1) % 20, "type": 0, "weight": 1.0,
+         "features": []}
+        for i in range(20)
+    ]
+    g = Graph.from_json({"nodes": nodes, "edges": edges})
+    flow = DeviceSageFlow(g, fanouts=[2], batch_size=64, root_node_type=1)
+    mb = jax.jit(flow.sample)(jax.random.PRNGKey(0))
+    ids = np.concatenate([np.asarray(s.node_ids) for s in g.shards])
+    roots = ids[np.asarray(mb.feats[0]) - 1]
+    assert np.all(roots % 2 == 1), "drew a type-0 root"
+
+
 def test_weighted_structure_matches_host_weighted_lean():
     """Weighted graphs ship bf16 edge weights, leaf-for-leaf like the
     host weighted-lean wire (sage.py _lean_w)."""
@@ -285,6 +308,138 @@ def test_mesh_mismatch_rejected(graph, tmp_path):
         EstimatorConfig(model_dir=str(tmp_path / "mm3")),
         mesh=make_mesh(8),
     )
+
+
+def test_walk_flow_pairs_match_host_gen_pair(graph):
+    """The static column gather reproduces walk.py gen_pair exactly: run
+    both on the SAME walk matrix and compare pairs + mask."""
+    from euler_tpu.dataflow import DeviceWalkFlow
+    from euler_tpu.dataflow.walk import gen_pair
+    from euler_tpu.graph.store import DEFAULT_ID
+
+    flow = DeviceWalkFlow(graph, batch_size=6, walk_len=4, window=2)
+    ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+    rng = np.random.default_rng(0)
+    walk_rows = rng.integers(0, len(ids), (6, 5))
+    walk_rows[2, 3:] = -1  # dead tail
+    walks_ids = np.where(walk_rows >= 0, ids[np.maximum(walk_rows, 0)],
+                         DEFAULT_ID)
+    pairs, mask = gen_pair(walks_ids, 2, 2)
+    dev_walks = np.where(walk_rows >= 0, walk_rows + 1, 0)
+    src = dev_walks[:, flow._src_cols] * flow._col_valid
+    ctx = dev_walks[:, flow._ctx_cols] * flow._col_valid
+    dmask = ((src > 0) & (ctx > 0)).reshape(-1)
+    np.testing.assert_array_equal(dmask, mask)
+    sel = mask
+    np.testing.assert_array_equal(
+        ids[src.reshape(-1)[sel] - 1], pairs[sel, 0]
+    )
+    np.testing.assert_array_equal(
+        ids[ctx.reshape(-1)[sel] - 1], pairs[sel, 1]
+    )
+
+
+def test_walk_flow_walks_follow_edges(graph):
+    """Consecutive sampled walk hops must be true edges (or dead)."""
+    from euler_tpu.dataflow import DeviceWalkFlow
+
+    flow = DeviceWalkFlow(graph, batch_size=8, walk_len=3, window=1)
+    mb = jax.jit(flow.sample)(jax.random.PRNGKey(0))
+    ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+    # reconstruct walks via src/pos of the window-1 offset blocks is
+    # convoluted; instead re-trace the walk with the same key pieces via
+    # membership: every (src, pos) pair at offset ±1 must be an edge
+    src, pos, mask = (np.asarray(mb["src"]), np.asarray(mb["pos"]),
+                      np.asarray(mb["mask"]))
+    nbr_of = {}
+    for i, nid in enumerate(ids):
+        nbr, _, _, m, _ = graph.get_full_neighbor(np.array([nid], np.uint64))
+        nbr_of[int(nid)] = set(int(x) for x in nbr[0][m[0]])
+    checked = 0
+    L = flow.walk_len + 1
+    for pi in np.nonzero(mask)[0]:
+        assert int(src[pi]) in nbr_of and int(pos[pi]) in nbr_of
+        checked += 1
+    assert checked > 0
+    # strict adjacency on the off=+1 block (window=1 → offsets (-1, +1),
+    # block 1 = off=+1): pairs are (walk[t], walk[t+1]), so pos must be a
+    # sampled out-neighbor of src
+    M = flow.pairs_per_walk
+    per = L
+    src2 = src.reshape(8, M)[:, per : 2 * per]
+    pos2 = pos.reshape(8, M)[:, per : 2 * per]
+    m2 = mask.reshape(8, M)[:, per : 2 * per]
+    for w in range(8):
+        for t in range(per):
+            if m2[w, t]:
+                assert int(pos2[w, t]) in nbr_of[int(src2[w, t])]
+
+
+def test_walk_flow_trains_skipgram(graph, tmp_path):
+    from euler_tpu.dataflow import DeviceWalkFlow
+    from euler_tpu.models.embedding_models import SkipGramModel
+
+    flow = DeviceWalkFlow(graph, batch_size=16, walk_len=3, window=1,
+                          num_negs=3)
+    est = Estimator(
+        SkipGramModel(num_nodes=300, dim=16), flow,
+        EstimatorConfig(model_dir=str(tmp_path / "dw"), learning_rate=0.05,
+                        log_steps=10**9, steps_per_call=4),
+    )
+    losses = est.train(total_steps=32, log=False, save=False)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+def _ring_graph(n=40):
+    """Bidirectional ring: every node has edges to both neighbors, so a
+    return edge always exists and node2vec biases are fully observable."""
+    from euler_tpu.graph import Graph
+
+    nodes = [
+        {"id": i, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense", "value": [1.0]}]}
+        for i in range(n)
+    ]
+    edges = [
+        {"src": i, "dst": (i + d) % n, "type": 0, "weight": 1.0,
+         "features": []}
+        for i in range(n)
+        for d in (1, n - 1)
+    ]
+    return Graph.from_json({"nodes": nodes, "edges": edges})
+
+
+def test_walk_flow_node2vec_bias():
+    """On a bidirectional ring, p→0 forces immediate backtracking
+    (walk[2] == walk[0] for nearly every walk) and p→∞ forbids it."""
+    from euler_tpu.dataflow import DeviceWalkFlow
+
+    g = _ring_graph(40)
+
+    def back_rate(p, q, key=3):
+        flow = DeviceWalkFlow(g, batch_size=64, walk_len=2, window=1,
+                              p=p, q=q)
+        mb = jax.jit(flow.sample)(jax.random.PRNGKey(key))
+        M, L = flow.pairs_per_walk, flow.walk_len + 1
+        src = np.asarray(mb["src"]).reshape(64, M)
+        pos = np.asarray(mb["pos"]).reshape(64, M)
+        mask = np.asarray(mb["mask"]).reshape(64, M)
+        # offsets (-1, +1): block 1 = off +1 → pairs (walk[t], walk[t+1])
+        w0, w2 = src[:, L], pos[:, L + 1]
+        ok = mask[:, L] & mask[:, L + 1]
+        assert ok.sum() >= 32
+        return float((w2[ok] == w0[ok]).mean())
+
+    assert back_rate(1e-6, 1.0) > 0.95
+    assert back_rate(1e6, 1.0) < 0.05
+    # q→0 prefers prev-adjacent nodes: on the ring prev's neighbors are
+    # {walk[0], cur's 2-hop-back node} — with p huge and q tiny, the walk
+    # must still avoid exact backtracking but stay near prev, which on a
+    # ring means w2 != w0 (already covered) — so just pin the unbiased
+    # rate for contrast: ~50/50 on a 2-regular ring
+    r = back_rate(1.0, 1.0)
+    assert 0.3 < r < 0.7, r
 
 
 def test_remainder_steps(graph, tmp_path):
