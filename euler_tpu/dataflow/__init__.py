@@ -1,5 +1,6 @@
 from euler_tpu.dataflow.base import Block, DataFlow, MiniBatch, fanout_block  # noqa: F401
 from euler_tpu.dataflow.device import (  # noqa: F401
+    DeviceEdgeFlow,
     DeviceGraphTables,
     DeviceSageFlow,
     DeviceWalkFlow,

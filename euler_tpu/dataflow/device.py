@@ -45,11 +45,12 @@ _STAGE_CHUNK = 16384
 class DeviceGraphTables:
     """HBM-resident graph tables + traced draw primitives.
 
-    Stages (once, host-side) the padded adjacency, degree vector,
-    cumulative edge-weight CDF (weighted graphs only), quantized
-    node-weight CDF (non-uniform node weights only), and the id↔row
-    maps. Subclasses compose `_draw_roots` / `_draw_neighbors` into
-    batch shapes; all draws are jit-traceable.
+    Stages (once, host-side) the padded adjacency, degree vector, raw
+    edge-weight rows (weighted graphs only — the per-row CDF is a cumsum
+    on the gathered rows at draw time), a quantized node-weight CDF
+    (non-uniform node weights only), and the id↔row maps. Subclasses
+    compose `_draw_roots` / `_draw_neighbors` into batch shapes; all
+    draws are jit-traceable.
     """
 
     is_device_flow = True
@@ -125,7 +126,11 @@ class DeviceGraphTables:
             deg[sl] = (block > 0).sum(axis=1)
         # a positive-degree row whose weights are all zero is unsampleable
         # (host _WeightedSampler semantics: zero total → padding)
-        deg[wtab.sum(axis=1) <= 0.0] = 0
+        # per-node out-strength (edge-weight row sums): zero-strength rows
+        # are unsampleable, and DeviceEdgeFlow draws edge sources ∝ it
+        strength = wtab.sum(axis=1, dtype=np.float64)
+        deg[strength <= 0.0] = 0
+        self._out_strength = strength
         self.adj = jax.device_put(adj)
         self.deg = jax.device_put(deg)
         self.unit_w = unit_w
@@ -451,5 +456,60 @@ class DeviceWalkFlow(DeviceGraphTables):
     def __call__(self):
         raise TypeError(
             "DeviceWalkFlow is not a host batch_fn; pass it to an Estimator "
+            "(detected via is_device_flow) or call .sample(key) inside jit"
+        )
+
+
+class DeviceEdgeFlow(DeviceGraphTables):
+    """On-device weighted edge sampling for LINE (examples/line parity).
+
+    Replaces the host `line_batches` source (graph.sample_edge +
+    sample_node negatives, models/embedding_models.py): an edge drawn
+    ∝ weight factors into source ∝ out-strength (uint32-quantized CDF)
+    times neighbor-within-row (the shared `_draw_neighbors` CDF draw) —
+    P(e) = strength(src)/Σstrength · w(e)/strength(src) = w(e)/W, the
+    same distribution the host _WeightedSampler draws from the flat edge
+    list. `sample(key)` returns the SkipGramModel dict batch.
+    """
+
+    def __init__(
+        self,
+        graph,
+        batch_size: int,
+        num_negs: int = 5,
+        edge_types=None,
+        max_degree: int = 512,
+        mesh=None,
+    ):
+        super().__init__(graph, edge_types, max_degree, mesh=mesh)
+        self.batch_size = int(batch_size)
+        self.num_negs = int(num_negs)
+        cum = np.cumsum(self._out_strength[1:])
+        if cum.size == 0 or cum[-1] <= 0:
+            raise ValueError("graph has no sampleable edges")
+        self.edge_src_cdf = jax.device_put(
+            np.floor(cum / cum[-1] * np.float64(2**32 - 1)).astype(np.uint32)
+        )
+
+    def sample(self, key) -> dict:
+        """key → SkipGramModel batch dict, jit-traceable."""
+        ksrc, kdst, kneg = jax.random.split(key, 3)
+        r = jax.random.bits(ksrc, (self.batch_size,), dtype=jnp.uint32)
+        pick = jnp.searchsorted(self.edge_src_cdf, r, side="right")
+        src = jnp.minimum(pick, self.num_nodes - 1).astype(jnp.int32) + 1
+        dst, _ = self._draw_neighbors(src, kdst, 1)
+        negs = self._draw_roots(kneg, self.batch_size * self.num_negs)
+        return {
+            "src": self._dp(self.node_id[src]),
+            "pos": self._dp(self.node_id[dst]),
+            "negs": self._dp(
+                self.node_id[negs].reshape(-1, self.num_negs)
+            ),
+            "mask": self._dp(dst > 0),
+        }
+
+    def __call__(self):
+        raise TypeError(
+            "DeviceEdgeFlow is not a host batch_fn; pass it to an Estimator "
             "(detected via is_device_flow) or call .sample(key) inside jit"
         )

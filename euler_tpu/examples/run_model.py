@@ -80,7 +80,7 @@ def build_parser():
     ap.add_argument("--device-flow", action="store_true",
                     help="sample batches ON the accelerator (HBM-resident "
                          "adjacency, zero per-step wire bytes) — conv "
-                         "models and deepwalk/node2vec, local graphs only")
+                         "models, deepwalk/node2vec/line, local graphs only")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize conv layers on backward "
                          "(jax.checkpoint) — trades FLOPs for HBM on "
@@ -139,6 +139,14 @@ def main(argv=None):
     label_dim = getattr(ds, "num_classes", 2) if ds else 2
     dims = [args.hidden_dim] * args.layers
     flow = None  # set by families that evaluate/infer through a dataflow
+    if args.device_flow and not (
+        name in ("deepwalk", "node2vec", "line")
+        or (name in CONV_MODELS and CONV_MODELS[name])
+    ):
+        raise SystemExit(
+            f"--device-flow is not implemented for model {name!r} (conv "
+            "models, deepwalk/node2vec/line only) — rerun without the flag"
+        )
 
     # ---- family dispatch -------------------------------------------------
     if name in KG_MODELS:
@@ -161,17 +169,21 @@ def main(argv=None):
             num_nodes=max_id, dim=args.embedding_dim,
             shared_context=(name == "line"),
         )
-        if args.device_flow and name != "line":
-            from euler_tpu.dataflow import DeviceWalkFlow
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceEdgeFlow, DeviceWalkFlow
 
-            bf = DeviceWalkFlow(
-                graph, args.batch_size, args.walk_len, args.window,
-                args.num_negs, p=args.p if name == "node2vec" else 1.0,
-                q=args.q if name == "node2vec" else 1.0, mesh=mesh,
+            bf = (
+                DeviceEdgeFlow(
+                    graph, args.batch_size, args.num_negs, mesh=mesh
+                )
+                if name == "line"
+                else DeviceWalkFlow(
+                    graph, args.batch_size, args.walk_len, args.window,
+                    args.num_negs, p=args.p if name == "node2vec" else 1.0,
+                    q=args.q if name == "node2vec" else 1.0, mesh=mesh,
+                )
             )
         else:
-            if args.device_flow:
-                print("# --device-flow: line samples edges; host path kept")
             bf = (
                 line_batches(graph, args.batch_size, args.num_negs, rng=rng)
                 if name == "line"

@@ -442,6 +442,48 @@ def test_walk_flow_node2vec_bias():
     assert 0.3 < r < 0.7, r
 
 
+def test_edge_flow_distribution_and_training(tmp_path):
+    """DeviceEdgeFlow draws edges ∝ weight (LINE parity) and trains."""
+    from euler_tpu.dataflow import DeviceEdgeFlow
+    from euler_tpu.models.embedding_models import SkipGramModel
+
+    g = random_graph(num_nodes=60, out_degree=2, feat_dim=4, seed=5)
+    store = g.shards[0]
+    w = np.asarray(store.arrays["edge_weights"], dtype=np.float32)
+    w[0::2], w[1::2] = 1.0, 3.0
+    store.arrays["edge_weights"][:] = w
+    store.__init__(store.meta, store.arrays, store.part)
+    flow = DeviceEdgeFlow(g, batch_size=256, num_negs=3)
+    fn = jax.jit(flow.sample)
+    ids = np.concatenate([np.asarray(s.node_ids) for s in g.shards])
+    heavy = 0
+    total = 0
+    for t in range(3):  # 3×256 draws; tolerance below sized for ~768
+        mb = fn(jax.random.PRNGKey(t))
+        src, pos, mask = (np.asarray(mb["src"]), np.asarray(mb["pos"]),
+                          np.asarray(mb["mask"]))
+        assert mask.all()  # every node has out-edges in this graph
+        for s, d in zip(src, pos):
+            nbr, wfull, _, m, _ = g.get_full_neighbor(
+                np.array([s], np.uint64)
+            )
+            wd = {int(a): float(b) for a, b in
+                  zip(nbr[0][m[0]], wfull[0][m[0]])}
+            assert int(d) in wd  # a real edge
+            total += 1
+            heavy += int(wd[int(d)] == 3.0)
+    assert abs(heavy / total - 0.75) < 0.06, heavy / total
+    est = Estimator(
+        SkipGramModel(num_nodes=60, dim=8), flow,
+        EstimatorConfig(model_dir=str(tmp_path / "line"),
+                        learning_rate=0.05, log_steps=10**9,
+                        steps_per_call=4),
+    )
+    losses = est.train(total_steps=16, log=False, save=False)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
 def test_remainder_steps(graph, tmp_path):
     """total_steps not a multiple of steps_per_call exercises the
     single-step remainder path with sliced flow keys."""
