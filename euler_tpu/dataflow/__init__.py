@@ -3,6 +3,7 @@ from euler_tpu.dataflow.device import (  # noqa: F401
     DeviceEdgeFlow,
     DeviceGraphTables,
     DeviceSageFlow,
+    DeviceUnsupSageFlow,
     DeviceWalkFlow,
 )
 from euler_tpu.dataflow.sage import FullNeighborDataFlow, SageDataFlow  # noqa: F401

@@ -147,6 +147,19 @@ class DeviceGraphTables:
         wn = np.concatenate(
             [np.asarray(s.node_weights, dtype=np.float64) for s in graph.shards]
         )
+        # global (unrestricted) node CDF — negative sampling draws from
+        # ALL nodes even when roots are pool/type-restricted (host
+        # unsupervised_batches neg_type=-1 parity)
+        self.global_cdf = None
+        if wn.size and not np.all(wn == wn[0]):
+            gcum = np.cumsum(wn)
+            if gcum[-1] <= 0:
+                raise ValueError("graph node weights sum to zero")
+            self.global_cdf = jax.device_put(
+                np.floor(gcum / gcum[-1] * np.float64(2**32 - 1)).astype(
+                    np.uint32
+                )
+            )
         pool_rows = None
         if roots_pool is not None:
             pool_rows = graph.lookup_rows(
@@ -217,6 +230,15 @@ class DeviceGraphTables:
             return self.roots[pick]
         return jax.random.randint(key, (count,), 1, self.num_nodes + 1)
 
+    def _draw_global_nodes(self, key, count: int):
+        """[count] draws over ALL nodes (ignores roots_pool/root_node_type)
+        — the negative-sampling distribution (sample_node(-1) parity)."""
+        if self.global_cdf is not None:
+            r = jax.random.bits(key, (count,), dtype=jnp.uint32)
+            pick = jnp.searchsorted(self.global_cdf, r, side="right")
+            return jnp.minimum(pick, self.num_nodes - 1).astype(jnp.int32) + 1
+        return jax.random.randint(key, (count,), 1, self.num_nodes + 1)
+
     def _draw_neighbors(self, cur, key, k: int):
         """[W] rows → ([W·k] neighbor rows, [W·k] bf16 weights or None).
 
@@ -282,14 +304,13 @@ class DeviceSageFlow(DeviceGraphTables):
         else:
             self.label_table = None
 
-    def sample(self, key) -> MiniBatch:
-        """key → lean MiniBatch, jit-traceable (call inside the train step)."""
-        keys = jax.random.split(key, 1 + len(self.fanouts))
-        cur = self._dp(self._draw_roots(keys[0], self.batch_size))
+    def _fanout_batch(self, roots, key) -> MiniBatch:
+        """Traced multi-hop fanout from [B] root rows → lean MiniBatch."""
+        cur = self._dp(roots)
         feats = [cur]
         blocks = []
-        width = self.batch_size
-        for k, hk in zip(self.fanouts, keys[1:]):
+        width = roots.shape[0]
+        for k, hk in zip(self.fanouts, jax.random.split(key, len(self.fanouts))):
             nbr, ew = self._draw_neighbors(cur, hk, k)
             nbr = self._dp(nbr)
             if ew is not None:
@@ -318,10 +339,58 @@ class DeviceSageFlow(DeviceGraphTables):
             hop_ids=None,
         )
 
+    def sample(self, key) -> MiniBatch:
+        """key → lean MiniBatch, jit-traceable (call inside the train step)."""
+        kroot, khops = jax.random.split(key)
+        return self._fanout_batch(
+            self._draw_roots(kroot, self.batch_size), khops
+        )
+
     def __call__(self):
         raise TypeError(
             "DeviceSageFlow is not a host batch_fn; pass it to an Estimator "
             "(detected via is_device_flow) or call .sample(key) inside jit"
+        )
+
+
+class DeviceUnsupSageFlow(DeviceSageFlow):
+    """On-device (src, pos, negs) fanout triples for GraphSAGEUnsupervised.
+
+    Host parity: estimator.unsupervised_batches — pos is a sampled 1-hop
+    neighbor of src (falling back to src itself when src has none), negs
+    are globally drawn nodes; each of the three gets its own multi-hop
+    lean fanout batch. sample(key) returns the 3-tuple of MiniBatches the
+    model's (src, pos, negs) signature consumes.
+    """
+
+    def __init__(
+        self,
+        graph,
+        fanouts,
+        batch_size: int,
+        num_negs: int = 5,
+        edge_types=None,
+        max_degree: int = 512,
+        roots_pool: np.ndarray | None = None,
+        root_node_type: int = -1,
+        mesh=None,
+    ):
+        super().__init__(
+            graph, fanouts, batch_size, None, edge_types, max_degree,
+            roots_pool, root_node_type, mesh,
+        )
+        self.num_negs = int(num_negs)
+
+    def sample(self, key) -> tuple:
+        kroot, kpos, kneg, ks, kp, kn = jax.random.split(key, 6)
+        src = self._draw_roots(kroot, self.batch_size)
+        nbr, _ = self._draw_neighbors(src, kpos, 1)
+        pos = jnp.where(nbr > 0, nbr, src)
+        negs = self._draw_global_nodes(kneg, self.batch_size * self.num_negs)
+        return (
+            self._fanout_batch(src, ks),
+            self._fanout_batch(pos, kp),
+            self._fanout_batch(negs, kn),
         )
 
 
@@ -498,7 +567,7 @@ class DeviceEdgeFlow(DeviceGraphTables):
         pick = jnp.searchsorted(self.edge_src_cdf, r, side="right")
         src = jnp.minimum(pick, self.num_nodes - 1).astype(jnp.int32) + 1
         dst, _ = self._draw_neighbors(src, kdst, 1)
-        negs = self._draw_roots(kneg, self.batch_size * self.num_negs)
+        negs = self._draw_global_nodes(kneg, self.batch_size * self.num_negs)
         return {
             "src": self._dp(self.node_id[src]),
             "pos": self._dp(self.node_id[dst]),

@@ -165,7 +165,8 @@ class Estimator:
             self.opt_state = self.tx.init(self.params)
             return
         if self._device_flow is not None:
-            batch = (jax.jit(self._device_flow.sample)(self._flow_keys(0, 1)[0]),)
+            out = jax.jit(self._device_flow.sample)(self._flow_keys(0, 1)[0])
+            batch = out if isinstance(out, tuple) else (out,)
         else:
             batch = self._put(
                 self.batch_fn(), stacked=self.cfg.steps_per_call > 1
@@ -226,9 +227,12 @@ class Estimator:
 
     def _step_batch(self, xs):
         """Per-step scan/step input → model args. Host flows ship the
-        batch itself; device flows ship a PRNG key and sample on device."""
+        batch itself; device flows ship a PRNG key and sample on device.
+        A flow returning a tuple supplies multiple model args (e.g. the
+        unsupervised (src, pos, negs) triple)."""
         if self._device_flow is not None:
-            return (self._device_flow.sample(xs[0]),)
+            out = self._device_flow.sample(xs[0])
+            return out if isinstance(out, tuple) else (out,)
         return xs
 
     def _train_step(self):

@@ -140,12 +140,13 @@ def main(argv=None):
     dims = [args.hidden_dim] * args.layers
     flow = None  # set by families that evaluate/infer through a dataflow
     if args.device_flow and not (
-        name in ("deepwalk", "node2vec", "line")
+        name in ("deepwalk", "node2vec", "line", "graphsage_unsup")
         or (name in CONV_MODELS and CONV_MODELS[name])
     ):
         raise SystemExit(
             f"--device-flow is not implemented for model {name!r} (conv "
-            "models, deepwalk/node2vec/line only) — rerun without the flag"
+            "models, graphsage_unsup, deepwalk/node2vec/line only) — "
+            "rerun without the flag"
         )
 
     # ---- family dispatch -------------------------------------------------
@@ -280,13 +281,28 @@ def main(argv=None):
 
         flow = SageDataFlow(graph, [feature], fanouts=args.fanouts[: args.layers], rng=rng)
         model = GraphSAGEUnsupervised(dims=dims, remat=args.remat)
-        est = Estimator(
-            model,
-            unsupervised_batches(
-                graph, flow, args.batch_size, num_negs=args.num_negs, rng=rng
-            ),
-            cfg, mesh=mesh,
-        )
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceUnsupSageFlow
+            from euler_tpu.estimator import DeviceFeatureCache
+
+            est = Estimator(
+                model,
+                DeviceUnsupSageFlow(
+                    graph, fanouts=args.fanouts[: args.layers],
+                    batch_size=args.batch_size, num_negs=args.num_negs,
+                    mesh=mesh,
+                ),
+                cfg, mesh=mesh,
+                feature_cache=DeviceFeatureCache(graph, [feature]),
+            )
+        else:
+            est = Estimator(
+                model,
+                unsupervised_batches(
+                    graph, flow, args.batch_size, num_negs=args.num_negs, rng=rng
+                ),
+                cfg, mesh=mesh,
+            )
     elif name in CONV_MODELS and CONV_MODELS[name]:
         from euler_tpu.dataflow import SageDataFlow
         from euler_tpu.nn import SuperviseModel

@@ -484,6 +484,42 @@ def test_edge_flow_distribution_and_training(tmp_path):
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
 
 
+def test_unsup_flow_triples_and_training(graph, tmp_path):
+    """DeviceUnsupSageFlow: pos is a true neighbor of src (or src itself
+    when src is isolated), and the triple trains GraphSAGEUnsupervised."""
+    from euler_tpu.dataflow import DeviceUnsupSageFlow
+    from euler_tpu.models import GraphSAGEUnsupervised
+
+    flow = DeviceUnsupSageFlow(graph, fanouts=[4, 3], batch_size=16,
+                               num_negs=3)
+    src_mb, pos_mb, neg_mb = jax.jit(flow.sample)(jax.random.PRNGKey(0))
+    assert neg_mb.feats[0].shape == (48,)
+    ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+    src = ids[np.asarray(src_mb.feats[0]) - 1]
+    pos = ids[np.asarray(pos_mb.feats[0]) - 1]
+    for s, p in zip(src, pos):
+        nbr, _, _, m, _ = graph.get_full_neighbor(np.array([s], np.uint64))
+        assert int(p) in set(int(x) for x in nbr[0][m[0]]) | {int(s)}
+    est = Estimator(
+        GraphSAGEUnsupervised(dims=[16, 16]), flow,
+        EstimatorConfig(model_dir=str(tmp_path / "unsup"),
+                        learning_rate=0.05, log_steps=10**9,
+                        steps_per_call=4),
+        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+    )
+    losses = est.train(total_steps=16, log=False, save=False)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+    # a roots_pool restricts src but NOT negatives (host neg_type=-1
+    # parity): negs must escape a 3-node pool
+    ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+    pflow = DeviceUnsupSageFlow(graph, fanouts=[4, 3], batch_size=16,
+                                num_negs=3, roots_pool=ids[:3])
+    s_mb, _, n_mb = jax.jit(pflow.sample)(jax.random.PRNGKey(1))
+    assert set(np.asarray(s_mb.feats[0]).tolist()) <= {1, 2, 3}
+    assert len(set(np.asarray(n_mb.feats[0]).tolist())) > 3
+
+
 def test_remainder_steps(graph, tmp_path):
     """total_steps not a multiple of steps_per_call exercises the
     single-step remainder path with sliced flow keys."""
