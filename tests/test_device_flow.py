@@ -242,13 +242,21 @@ def test_estimator_trains_and_is_deterministic(graph, tmp_path):
         return est.train(total_steps=12, log=False, save=False)
 
     a = run(4)
-    b = run(4)
-    assert a == b, "same seed must reproduce the same loss sequence"
     assert a[-1] < a[0], "loss should fall on the label-correlated graph"
     # flow keys fold per GLOBAL step: grouping steps into dispatches
-    # differently must not change the batch stream
+    # differently must not change the batch stream (rtol covers the
+    # scan-vs-unrolled program difference, not sampling jitter)
     c = run(1)
     np.testing.assert_allclose(np.array(a), np.array(c), rtol=1e-4)
+    # bitwise same-seed reproducibility, asserted at the sampling layer
+    # (cheaper than a third training run, catches nondeterministic draws)
+    flow = DeviceSageFlow(graph, fanouts=[4, 3], batch_size=16,
+                          label_feature="label")
+    fn = jax.jit(flow.sample)
+    m1, m2 = fn(jax.random.PRNGKey(9)), fn(jax.random.PRNGKey(9))
+    for x, y in zip(jax.tree_util.tree_leaves(m1),
+                    jax.tree_util.tree_leaves(m2)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
 def test_mesh_data_parallel_loss_parity(graph, tmp_path):
@@ -351,10 +359,11 @@ def test_walk_flow_walks_follow_edges(graph):
     # membership: every (src, pos) pair at offset ±1 must be an edge
     src, pos, mask = (np.asarray(mb["src"]), np.asarray(mb["pos"]),
                       np.asarray(mb["mask"]))
-    nbr_of = {}
-    for i, nid in enumerate(ids):
-        nbr, _, _, m, _ = graph.get_full_neighbor(np.array([nid], np.uint64))
-        nbr_of[int(nid)] = set(int(x) for x in nbr[0][m[0]])
+    nbr_all, _, _, m_all, _ = graph.get_full_neighbor(ids)
+    nbr_of = {
+        int(nid): set(int(x) for x in nbr_all[i][m_all[i]])
+        for i, nid in enumerate(ids)
+    }
     checked = 0
     L = flow.walk_len + 1
     for pi in np.nonzero(mask)[0]:
@@ -456,6 +465,12 @@ def test_edge_flow_distribution_and_training(tmp_path):
     flow = DeviceEdgeFlow(g, batch_size=256, num_negs=3)
     fn = jax.jit(flow.sample)
     ids = np.concatenate([np.asarray(s.node_ids) for s in g.shards])
+    nbr_all, w_all, _, m_all, _ = g.get_full_neighbor(ids)
+    wd_of = {
+        int(nid): {int(a): float(b) for a, b in
+                   zip(nbr_all[i][m_all[i]], w_all[i][m_all[i]])}
+        for i, nid in enumerate(ids)
+    }
     heavy = 0
     total = 0
     for t in range(3):  # 3×256 draws; tolerance below sized for ~768
@@ -464,11 +479,7 @@ def test_edge_flow_distribution_and_training(tmp_path):
                           np.asarray(mb["mask"]))
         assert mask.all()  # every node has out-edges in this graph
         for s, d in zip(src, pos):
-            nbr, wfull, _, m, _ = g.get_full_neighbor(
-                np.array([s], np.uint64)
-            )
-            wd = {int(a): float(b) for a, b in
-                  zip(nbr[0][m[0]], wfull[0][m[0]])}
+            wd = wd_of[int(s)]
             assert int(d) in wd  # a real edge
             total += 1
             heavy += int(wd[int(d)] == 3.0)
@@ -497,9 +508,9 @@ def test_unsup_flow_triples_and_training(graph, tmp_path):
     ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
     src = ids[np.asarray(src_mb.feats[0]) - 1]
     pos = ids[np.asarray(pos_mb.feats[0]) - 1]
-    for s, p in zip(src, pos):
-        nbr, _, _, m, _ = graph.get_full_neighbor(np.array([s], np.uint64))
-        assert int(p) in set(int(x) for x in nbr[0][m[0]]) | {int(s)}
+    nbr, _, _, m, _ = graph.get_full_neighbor(src)
+    for i, (s, p) in enumerate(zip(src, pos)):
+        assert int(p) in set(int(x) for x in nbr[i][m[i]]) | {int(s)}
     est = Estimator(
         GraphSAGEUnsupervised(dims=[16, 16]), flow,
         EstimatorConfig(model_dir=str(tmp_path / "unsup"),
