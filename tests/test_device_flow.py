@@ -242,21 +242,14 @@ def test_estimator_trains_and_is_deterministic(graph, tmp_path):
         return est.train(total_steps=12, log=False, save=False)
 
     a = run(4)
+    b = run(4)
+    assert a == b, "same seed must reproduce the loss sequence bitwise"
     assert a[-1] < a[0], "loss should fall on the label-correlated graph"
     # flow keys fold per GLOBAL step: grouping steps into dispatches
     # differently must not change the batch stream (rtol covers the
     # scan-vs-unrolled program difference, not sampling jitter)
     c = run(1)
     np.testing.assert_allclose(np.array(a), np.array(c), rtol=1e-4)
-    # bitwise same-seed reproducibility, asserted at the sampling layer
-    # (cheaper than a third training run, catches nondeterministic draws)
-    flow = DeviceSageFlow(graph, fanouts=[4, 3], batch_size=16,
-                          label_feature="label")
-    fn = jax.jit(flow.sample)
-    m1, m2 = fn(jax.random.PRNGKey(9)), fn(jax.random.PRNGKey(9))
-    for x, y in zip(jax.tree_util.tree_leaves(m1),
-                    jax.tree_util.tree_leaves(m2)):
-        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
 def test_mesh_data_parallel_loss_parity(graph, tmp_path):
