@@ -306,7 +306,11 @@ def run(platform: str) -> tuple[float, dict]:
     # serialize with model compute on the same cores (measured: host
     # 2.99M vs traced 2.18M edges/s on the 1-core fallback box)
     env_df = os.environ.get("EULER_BENCH_DEVICE_FLOW")
-    device_flow = (env_df != "0") if env_df is not None else not on_cpu
+    # --smoke is a wiring check, not a measurement: default to the device
+    # flow there so the production-default path stays smoke-covered
+    device_flow = (
+        (env_df != "0") if env_df is not None else (SMOKE or not on_cpu)
+    )
     if device_flow:
         from euler_tpu.dataflow import DeviceSageFlow
 

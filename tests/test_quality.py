@@ -210,6 +210,44 @@ def test_graphsage_cora_f1(cora_like, tmp_path):
     assert 0.84 < f1 < 0.96, f"GraphSAGE f1 {f1:.3f} out of calibrated band"
 
 
+def test_graphsage_cora_f1_device_flow(cora_like, tmp_path):
+    """Device-flow mirror of test_graphsage_cora_f1: the on-accelerator
+    sampler (HBM adjacency, traced draws — dataflow/device.py) must train
+    to the same calibrated band as the host sampled flow. This pins that
+    moving sampling onto the device changes WHERE draws happen, not what
+    the model learns — a subtly biased device sampler would land below
+    the band."""
+    g, _, _, types = cora_like
+    tr_ids, te_ids = _splits(types, train_pool=(0, 1))
+    from euler_tpu.dataflow import DeviceSageFlow, SageDataFlow
+    from euler_tpu.estimator import DeviceFeatureCache
+
+    dflow = DeviceSageFlow(
+        g, fanouts=[10, 10], batch_size=64, label_feature="label",
+        roots_pool=tr_ids,
+    )
+    model = SuperviseModel(conv="sage", dims=[32, 32], label_dim=7)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "sage_dev"), learning_rate=0.01,
+        log_steps=10**9, steps_per_call=5,
+    )
+    est = Estimator(
+        model, dflow, cfg, feature_cache=DeviceFeatureCache(g, ["feature"])
+    )
+    est.train(total_steps=150, save=False, log=False)
+    host = SageDataFlow(
+        g, ["feature"], fanouts=[10, 10], label_feature="label",
+        rng=np.random.default_rng(0),
+    )
+    evals = [
+        (host.query(te_ids[i : i + 200]),) for i in range(0, 1000, 200)
+    ]
+    f1 = est.evaluate(evals)["f1"]
+    assert 0.84 < f1 < 0.96, (
+        f"device-flow GraphSAGE f1 {f1:.3f} out of the host flow's band"
+    )
+
+
 @pytest.mark.parametrize(
     "conv,published,lo,hi",
     [
