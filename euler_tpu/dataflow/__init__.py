@@ -2,6 +2,7 @@ from euler_tpu.dataflow.base import Block, DataFlow, MiniBatch, fanout_block  # 
 from euler_tpu.dataflow.device import (  # noqa: F401
     DeviceEdgeFlow,
     DeviceGraphTables,
+    DeviceKGFlow,
     DeviceSageFlow,
     DeviceUnsupSageFlow,
     DeviceWalkFlow,

@@ -63,6 +63,7 @@ class DeviceGraphTables:
         roots_pool: np.ndarray | None = None,
         root_node_type: int = -1,
         mesh=None,
+        stage_types: bool = False,
     ):
         """roots_pool: optional node ids to sample roots from (e.g. a
         train split); root_node_type restricts root draws to one node
@@ -105,10 +106,13 @@ class DeviceGraphTables:
         adj = np.zeros((n + 1, dmax), dtype=np.int32)
         deg = np.zeros(n + 1, dtype=np.int32)
         wtab = np.zeros((n + 1, dmax), dtype=np.float32)
+        ttab = (
+            np.full((n + 1, dmax), -1, dtype=np.int32) if stage_types else None
+        )
         unit_w = True
         for lo in range(0, n, _STAGE_CHUNK):
             sub = ids[lo : lo + _STAGE_CHUNK]
-            nbr, w, _, mask, _ = graph.get_full_neighbor(
+            nbr, w, tt, mask, _ = graph.get_full_neighbor(
                 sub, edge_types, max_degree=dmax
             )
             unit_w = unit_w and bool(np.all(w[mask] == 1.0))
@@ -123,6 +127,10 @@ class DeviceGraphTables:
             wtab[sl, : block.shape[1]] = np.take_along_axis(
                 np.where(block > 0, w, 0.0).astype(np.float32), order, axis=1
             )
+            if ttab is not None:  # edge types of each slot (KG relations)
+                ttab[sl, : block.shape[1]] = np.take_along_axis(
+                    np.where(block > 0, tt, -1).astype(np.int32), order, axis=1
+                )
             deg[sl] = (block > 0).sum(axis=1)
         # a positive-degree row whose weights are all zero is unsampleable
         # (host _WeightedSampler semantics: zero total → padding)
@@ -139,6 +147,7 @@ class DeviceGraphTables:
         # on the gathered rows at draw time — one table, no f32
         # cancellation from storing cumulative sums
         self.wtab = None if unit_w else jax.device_put(wtab)
+        self.ttab = jax.device_put(ttab) if ttab is not None else None
         # weight-proportional root draws (host sample_node parity): a
         # uint32-quantized CDF, binary-searched on device — over all nodes,
         # or over roots_pool's members when a pool restricts the draw.
@@ -239,8 +248,26 @@ class DeviceGraphTables:
             return jnp.minimum(pick, self.num_nodes - 1).astype(jnp.int32) + 1
         return jax.random.randint(key, (count,), 1, self.num_nodes + 1)
 
+    def _stage_edge_src_cdf(self):
+        """Quantized CDF over per-node out-strength: drawing a source from
+        it and then a neighbor within the row draws an edge ∝ weight
+        (P(e) = strength(src)/W · w(e)/strength(src) = w(e)/W — the host
+        sample_edge alias-table distribution)."""
+        cum = np.cumsum(self._out_strength[1:])
+        if cum.size == 0 or cum[-1] <= 0:
+            raise ValueError("graph has no sampleable edges")
+        self.edge_src_cdf = jax.device_put(
+            np.floor(cum / cum[-1] * np.float64(2**32 - 1)).astype(np.uint32)
+        )
+
+    def _draw_edge_sources(self, key, count: int):
+        """[count] edge-source rows (row+1 space) ∝ out-strength."""
+        r = jax.random.bits(key, (count,), dtype=jnp.uint32)
+        pick = jnp.searchsorted(self.edge_src_cdf, r, side="right")
+        return jnp.minimum(pick, self.num_nodes - 1).astype(jnp.int32) + 1
+
     def _draw_neighbors(self, cur, key, k: int):
-        """[W] rows → ([W·k] neighbor rows, [W·k] bf16 weights or None).
+        """[W] rows → ([W·k] rows, [W·k] bf16 weights or None, [W, k] slot idx).
 
         Uniform graphs draw a slot index directly; weighted graphs invert
         the per-row cumulative CDF. Padding rows (0) yield padding.
@@ -267,7 +294,7 @@ class DeviceGraphTables:
                 .reshape(-1)
                 .astype(jnp.bfloat16)
             )
-        return nbr, ew
+        return nbr, ew, idx
 
 
 class DeviceSageFlow(DeviceGraphTables):
@@ -311,7 +338,7 @@ class DeviceSageFlow(DeviceGraphTables):
         blocks = []
         width = roots.shape[0]
         for k, hk in zip(self.fanouts, jax.random.split(key, len(self.fanouts))):
-            nbr, ew = self._draw_neighbors(cur, hk, k)
+            nbr, ew, _ = self._draw_neighbors(cur, hk, k)
             nbr = self._dp(nbr)
             if ew is not None:
                 # weighted-lean wire parity: bf16 weights ride the batch
@@ -384,7 +411,7 @@ class DeviceUnsupSageFlow(DeviceSageFlow):
     def sample(self, key) -> tuple:
         kroot, kpos, kneg, ks, kp, kn = jax.random.split(key, 6)
         src = self._draw_roots(kroot, self.batch_size)
-        nbr, _ = self._draw_neighbors(src, kpos, 1)
+        nbr, _, _ = self._draw_neighbors(src, kpos, 1)
         pos = jnp.where(nbr > 0, nbr, src)
         negs = self._draw_global_nodes(kneg, self.batch_size * self.num_negs)
         return (
@@ -502,7 +529,7 @@ class DeviceWalkFlow(DeviceGraphTables):
             if self.biased:
                 nxt = self._walk_step(cur, prev, sk)
             else:
-                nxt, _ = self._draw_neighbors(cur, sk, 1)
+                nxt, _, _ = self._draw_neighbors(cur, sk, 1)
             prev, cur = cur, self._dp(nxt)
             walk.append(cur)
         walks = jnp.stack(walk, axis=1)  # [B, L+1] rows (0 = dead)
@@ -553,20 +580,13 @@ class DeviceEdgeFlow(DeviceGraphTables):
         super().__init__(graph, edge_types, max_degree, mesh=mesh)
         self.batch_size = int(batch_size)
         self.num_negs = int(num_negs)
-        cum = np.cumsum(self._out_strength[1:])
-        if cum.size == 0 or cum[-1] <= 0:
-            raise ValueError("graph has no sampleable edges")
-        self.edge_src_cdf = jax.device_put(
-            np.floor(cum / cum[-1] * np.float64(2**32 - 1)).astype(np.uint32)
-        )
+        self._stage_edge_src_cdf()
 
     def sample(self, key) -> dict:
         """key → SkipGramModel batch dict, jit-traceable."""
         ksrc, kdst, kneg = jax.random.split(key, 3)
-        r = jax.random.bits(ksrc, (self.batch_size,), dtype=jnp.uint32)
-        pick = jnp.searchsorted(self.edge_src_cdf, r, side="right")
-        src = jnp.minimum(pick, self.num_nodes - 1).astype(jnp.int32) + 1
-        dst, _ = self._draw_neighbors(src, kdst, 1)
+        src = self._draw_edge_sources(ksrc, self.batch_size)
+        dst, _, _ = self._draw_neighbors(src, kdst, 1)
         negs = self._draw_global_nodes(kneg, self.batch_size * self.num_negs)
         return {
             "src": self._dp(self.node_id[src]),
@@ -580,5 +600,59 @@ class DeviceEdgeFlow(DeviceGraphTables):
     def __call__(self):
         raise TypeError(
             "DeviceEdgeFlow is not a host batch_fn; pass it to an Estimator "
+            "(detected via is_device_flow) or call .sample(key) inside jit"
+        )
+
+
+class DeviceKGFlow(DeviceGraphTables):
+    """On-device (h, r, t) triple sampling + corrupted negatives for the
+    TransX family (models/kg.py `kg_batches` parity).
+
+    Edges draw ∝ weight via the same source-strength × within-row
+    factorization as `DeviceEdgeFlow`; the drawn slot's relation id comes
+    from a staged edge-type table (the `tt` plane of get_full_neighbor,
+    compacted alongside the adjacency). Corrupted heads/tails draw from
+    the global node CDF (host sample_node(-1) parity). `sample(key)`
+    returns the exact dict batch `TransX.__call__` consumes.
+    """
+
+    def __init__(
+        self,
+        graph,
+        batch_size: int,
+        num_negs: int = 8,
+        edge_types=None,
+        max_degree: int = 512,
+        mesh=None,
+    ):
+        super().__init__(
+            graph, edge_types, max_degree, mesh=mesh, stage_types=True
+        )
+        self.batch_size = int(batch_size)
+        self.num_negs = int(num_negs)
+        self._stage_edge_src_cdf()
+
+    def sample(self, key) -> dict:
+        """key → TransX batch dict, jit-traceable."""
+        ksrc, kdst, kneg = jax.random.split(key, 3)
+        h = self._draw_edge_sources(ksrc, self.batch_size)
+        t, _, idx = self._draw_neighbors(h, kdst, 1)
+        rel = self.ttab[h[:, None], idx].reshape(-1)
+        negs = self.node_id[
+            self._draw_global_nodes(
+                kneg, self.batch_size * self.num_negs * 2
+            )
+        ].reshape(2, self.batch_size, self.num_negs)
+        return {
+            "h": self._dp(self.node_id[h]),
+            "r": self._dp(rel),
+            "t": self._dp(self.node_id[t]),
+            "neg_h": self._dp(negs[0]),
+            "neg_t": self._dp(negs[1]),
+        }
+
+    def __call__(self):
+        raise TypeError(
+            "DeviceKGFlow is not a host batch_fn; pass it to an Estimator "
             "(detected via is_device_flow) or call .sample(key) inside jit"
         )

@@ -141,12 +141,13 @@ def main(argv=None):
     flow = None  # set by families that evaluate/infer through a dataflow
     if args.device_flow and not (
         name in ("deepwalk", "node2vec", "line", "graphsage_unsup")
+        or name in KG_MODELS
         or (name in CONV_MODELS and CONV_MODELS[name])
     ):
         raise SystemExit(
             f"--device-flow is not implemented for model {name!r} (conv "
-            "models, graphsage_unsup, deepwalk/node2vec/line only) — "
-            "rerun without the flag"
+            "models, graphsage_unsup, deepwalk/node2vec/line, and the "
+            "TransX family only) — rerun without the flag"
         )
 
     # ---- family dispatch -------------------------------------------------
@@ -159,10 +160,15 @@ def main(argv=None):
             dim=args.embedding_dim,
             variant=name,
         )
-        est = Estimator(
-            model, kg_batches(graph, args.batch_size, args.num_negs, rng=rng),
-            cfg, mesh=mesh,
-        )
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceKGFlow
+
+            bf = DeviceKGFlow(
+                graph, args.batch_size, args.num_negs, mesh=mesh
+            )
+        else:
+            bf = kg_batches(graph, args.batch_size, args.num_negs, rng=rng)
+        est = Estimator(model, bf, cfg, mesh=mesh)
     elif name in ("deepwalk", "node2vec", "line"):
         from euler_tpu.models import SkipGramModel, deepwalk_batches, line_batches
 

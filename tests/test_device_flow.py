@@ -524,6 +524,46 @@ def test_unsup_flow_triples_and_training(graph, tmp_path):
     assert len(set(np.asarray(n_mb.feats[0]).tolist())) > 3
 
 
+def test_kg_flow_triples_and_training(tmp_path):
+    """DeviceKGFlow: (h, r, t) are true typed edges, negatives are global,
+    and the triple dict trains TransE."""
+    from euler_tpu.dataflow import DeviceKGFlow
+    from euler_tpu.graph import Graph
+    from euler_tpu.models import TransX
+
+    n = 40
+    nodes = [
+        {"id": i, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense", "value": [1.0]}]}
+        for i in range(n)
+    ]
+    edges = [
+        {"src": i, "dst": (i + d) % n, "type": d - 1, "weight": 1.0,
+         "features": []}
+        for i in range(n)
+        for d in (1, 2)
+    ]
+    g = Graph.from_json({"nodes": nodes, "edges": edges})
+    flow = DeviceKGFlow(g, batch_size=64, num_negs=4)
+    mb = jax.jit(flow.sample)(jax.random.PRNGKey(0))
+    h = np.asarray(mb["h"])
+    r = np.asarray(mb["r"])
+    t = np.asarray(mb["t"])
+    # every drawn triple must be a real typed edge of the ring
+    np.testing.assert_array_equal(t, (h + r + 1) % n)
+    assert set(np.unique(r).tolist()) == {0, 1}
+    assert mb["neg_h"].shape == (64, 4) and mb["neg_t"].shape == (64, 4)
+    est = Estimator(
+        TransX(num_entities=n, num_relations=2, dim=8, variant="transe"),
+        flow,
+        EstimatorConfig(model_dir=str(tmp_path / "kg"), learning_rate=0.05,
+                        log_steps=10**9, steps_per_call=4),
+    )
+    losses = est.train(total_steps=16, log=False, save=False)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
 def test_remainder_steps(graph, tmp_path):
     """total_steps not a multiple of steps_per_call exercises the
     single-step remainder path with sliced flow keys."""
