@@ -248,6 +248,43 @@ class DeviceGraphTables:
             return jnp.minimum(pick, self.num_nodes - 1).astype(jnp.int32) + 1
         return jax.random.randint(key, (count,), 1, self.num_nodes + 1)
 
+    def _draw_neighbors_typed(self, cur, key, k: int, rel: int):
+        """[W] rows → per-RELATION draws: ([W·k] rows, [W·k] f32 weights,
+        [W·k] valid mask). Requires stage_types=True. Weights are masked
+        to slots of type `rel` before the CDF inversion — the same
+        distribution as the host sample_neighbor(cur, [rel], k)."""
+        width = cur.shape[0]
+        nbr_rows = self.adj[cur]  # [W, D]
+        w = (
+            self.wtab[cur]
+            if self.wtab is not None
+            else (nbr_rows > 0).astype(jnp.float32)
+        )
+        w = w * (self.ttab[cur] == rel)
+        cw = jnp.cumsum(w, axis=1)
+        total = cw[:, -1]
+        u = jax.random.uniform(key, (width, k)) * total[:, None]
+        idx = (cw[:, None, :] <= u[:, :, None]).sum(axis=-1)
+        idx = jnp.minimum(idx, self.adj.shape[1] - 1)
+        # type-r support is NON-contiguous, so the u→1 f32 overshoot can
+        # land on a wrong-relation or padded slot (w there is 0); redirect
+        # those draws to the row's LAST in-support slot (the sibling
+        # _draw_neighbors' deg-1 clamp, generalized to a masked row)
+        wpick = jnp.take_along_axis(w, idx, axis=1)
+        last = jnp.argmax(
+            jnp.where(w > 0, jnp.arange(w.shape[1]), -1), axis=1
+        )
+        idx = jnp.where(wpick > 0, idx, last[:, None])
+        alive = total > 0
+        nbr = jnp.where(
+            alive[:, None], jnp.take_along_axis(nbr_rows, idx, axis=1), 0
+        )
+        ew = jnp.where(
+            alive[:, None], jnp.take_along_axis(w, idx, axis=1), 0.0
+        )
+        valid = (nbr > 0).reshape(-1)
+        return nbr.reshape(-1), ew.reshape(-1), valid
+
     def _stage_edge_src_cdf(self):
         """Quantized CDF over per-node out-strength: drawing a source from
         it and then a neighbor within the row draws an edge ∝ weight
@@ -655,4 +692,118 @@ class DeviceKGFlow(DeviceGraphTables):
         raise TypeError(
             "DeviceKGFlow is not a host batch_fn; pass it to an Estimator "
             "(detected via is_device_flow) or call .sample(key) inside jit"
+        )
+
+
+class DeviceRelationFlow(DeviceGraphTables):
+    """On-device per-relation fanouts for RGCN (relation.py parity).
+
+    One staged table set (adjacency + weight + type planes) serves every
+    relation: each hop's per-relation draw masks the type plane before
+    the CDF inversion (`_draw_neighbors_typed`), exactly the host
+    sample_neighbor(cur, [r], k) distribution, without R per-relation
+    adjacency copies. sample(key) returns the RelMiniBatch the RGCN
+    model consumes, with dense features gathered in-flow from an HBM
+    feature table (RelMiniBatch has no rows-mode hydration path).
+    """
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        num_relations: int,
+        batch_size: int,
+        fanout: int = 5,
+        num_hops: int = 2,
+        label_feature: str | None = None,
+        max_degree: int = 512,
+        roots_pool: np.ndarray | None = None,
+        root_node_type: int = -1,
+        mesh=None,
+    ):
+        super().__init__(
+            graph, None, max_degree, roots_pool, root_node_type, mesh,
+            stage_types=True,
+        )
+        from euler_tpu.estimator.feature_cache import DeviceFeatureCache
+
+        self.num_relations = int(num_relations)
+        self.batch_size = int(batch_size)
+        self.fanout = int(fanout)
+        self.num_hops = int(num_hops)
+        self.feat_table = DeviceFeatureCache(graph, list(feature_names)).table
+        self.label_table = (
+            DeviceFeatureCache(graph, [label_feature]).table
+            if label_feature is not None
+            else None
+        )
+
+    def sample(self, key) -> "RelMiniBatch":
+        from euler_tpu.dataflow.relation import RelMiniBatch
+
+        k, nr = self.fanout, self.num_relations
+        keys = jax.random.split(key, 1 + self.num_hops * nr)
+        cur = self._dp(self._draw_roots(keys[0], self.batch_size))
+        hop_rows = [cur]
+        hop_masks = [cur > 0]
+        rel_blocks = []
+        ki = 1
+        for _ in range(self.num_hops):
+            n = cur.shape[0]
+            nxt = []
+            blocks = []
+            for r in range(nr):
+                nbr, ew, valid = self._draw_neighbors_typed(
+                    cur, keys[ki], k, r
+                )
+                ki += 1
+                nxt.append(nbr.reshape(n, k))
+                # src slots for relation r sit at rows [i*nr*k + r*k + j]
+                src = (
+                    np.arange(n)[:, None] * nr * k
+                    + r * k
+                    + np.arange(k)[None, :]
+                ).reshape(-1)
+                blocks.append(
+                    Block(
+                        edge_src=jnp.asarray(src, jnp.int32),
+                        edge_dst=jnp.repeat(
+                            jnp.arange(n, dtype=jnp.int32), k
+                        ),
+                        edge_w=self._dp(ew.astype(jnp.float32)),
+                        mask=self._dp(valid),
+                        n_src=n * nr * k,
+                        n_dst=n,
+                    )
+                )
+            rel_blocks.append(tuple(blocks))
+            # next hop interleaves relations: [n, nr, k] flattened, same
+            # slot layout the edge_src indices above address
+            cur = self._dp(
+                jnp.stack(nxt, axis=1).reshape(-1)
+            )
+            hop_rows.append(cur)
+            hop_masks.append(cur > 0)
+        feats = tuple(self._dp(self.feat_table[rw]) for rw in hop_rows)
+        labels = (
+            self._dp(self.label_table[hop_rows[0]])
+            if self.label_table is not None
+            else None
+        )
+        return RelMiniBatch(
+            feats=feats,
+            masks=tuple(hop_masks),
+            rel_blocks=tuple(rel_blocks),
+            root_idx=self._dp(self.node_id[hop_rows[0]]),
+            labels=labels,
+            hop_ids=tuple(
+                self._dp(self.node_id[rw]) for rw in hop_rows
+            ),
+        )
+
+    def __call__(self):
+        raise TypeError(
+            "DeviceRelationFlow is not a host batch_fn; pass it to an "
+            "Estimator (detected via is_device_flow) or call .sample(key) "
+            "inside jit"
         )

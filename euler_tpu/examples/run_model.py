@@ -140,14 +140,14 @@ def main(argv=None):
     dims = [args.hidden_dim] * args.layers
     flow = None  # set by families that evaluate/infer through a dataflow
     if args.device_flow and not (
-        name in ("deepwalk", "node2vec", "line", "graphsage_unsup")
+        name in ("deepwalk", "node2vec", "line", "graphsage_unsup", "rgcn")
         or name in KG_MODELS
         or (name in CONV_MODELS and CONV_MODELS[name])
     ):
         raise SystemExit(
             f"--device-flow is not implemented for model {name!r} (conv "
-            "models, graphsage_unsup, deepwalk/node2vec/line, and the "
-            "TransX family only) — rerun without the flag"
+            "models, graphsage_unsup, rgcn, deepwalk/node2vec/line, and "
+            "the TransX family only) — rerun without the flag"
         )
 
     # ---- family dispatch -------------------------------------------------
@@ -242,10 +242,19 @@ def main(argv=None):
             dims=dims, num_relations=graph.meta.num_edge_types,
             label_dim=label_dim, num_bases=4,
         )
-        est = Estimator(
-            model, node_batches(graph, flow, args.batch_size, 0, rng=rng),
-            cfg, mesh=mesh,
-        )
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceRelationFlow
+
+            bf = DeviceRelationFlow(
+                graph, [feature],
+                num_relations=graph.meta.num_edge_types,
+                batch_size=args.batch_size, fanout=args.fanouts[0],
+                num_hops=args.layers, label_feature="label",
+                root_node_type=0, mesh=mesh,
+            )
+        else:
+            bf = node_batches(graph, flow, args.batch_size, 0, rng=rng)
+        est = Estimator(model, bf, cfg, mesh=mesh)
     elif name in ("gae", "vgae"):
         from euler_tpu.dataflow import SageDataFlow
         from euler_tpu.models import GAE, gae_batches
