@@ -3,6 +3,7 @@ from euler_tpu.dataflow.device import (  # noqa: F401
     DeviceEdgeFlow,
     DeviceGraphTables,
     DeviceKGFlow,
+    DeviceLayerwiseFlow,
     DeviceRelationFlow,
     DeviceSageFlow,
     DeviceUnsupSageFlow,

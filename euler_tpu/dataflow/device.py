@@ -55,6 +55,13 @@ class DeviceGraphTables:
 
     is_device_flow = True
 
+    def __call__(self):
+        raise TypeError(
+            f"{type(self).__name__} is not a host batch_fn; pass it to an "
+            "Estimator (detected via is_device_flow) or call .sample(key) "
+            "inside jit"
+        )
+
     def __init__(
         self,
         graph,
@@ -410,11 +417,6 @@ class DeviceSageFlow(DeviceGraphTables):
             self._draw_roots(kroot, self.batch_size), khops
         )
 
-    def __call__(self):
-        raise TypeError(
-            "DeviceSageFlow is not a host batch_fn; pass it to an Estimator "
-            "(detected via is_device_flow) or call .sample(key) inside jit"
-        )
 
 
 class DeviceUnsupSageFlow(DeviceSageFlow):
@@ -586,11 +588,6 @@ class DeviceWalkFlow(DeviceGraphTables):
             "mask": self._dp(mask.reshape(-1)),
         }
 
-    def __call__(self):
-        raise TypeError(
-            "DeviceWalkFlow is not a host batch_fn; pass it to an Estimator "
-            "(detected via is_device_flow) or call .sample(key) inside jit"
-        )
 
 
 class DeviceEdgeFlow(DeviceGraphTables):
@@ -634,11 +631,6 @@ class DeviceEdgeFlow(DeviceGraphTables):
             "mask": self._dp(dst > 0),
         }
 
-    def __call__(self):
-        raise TypeError(
-            "DeviceEdgeFlow is not a host batch_fn; pass it to an Estimator "
-            "(detected via is_device_flow) or call .sample(key) inside jit"
-        )
 
 
 class DeviceKGFlow(DeviceGraphTables):
@@ -688,11 +680,6 @@ class DeviceKGFlow(DeviceGraphTables):
             "neg_t": self._dp(negs[1]),
         }
 
-    def __call__(self):
-        raise TypeError(
-            "DeviceKGFlow is not a host batch_fn; pass it to an Estimator "
-            "(detected via is_device_flow) or call .sample(key) inside jit"
-        )
 
 
 class DeviceRelationFlow(DeviceGraphTables):
@@ -801,9 +788,105 @@ class DeviceRelationFlow(DeviceGraphTables):
             ),
         )
 
-    def __call__(self):
-        raise TypeError(
-            "DeviceRelationFlow is not a host batch_fn; pass it to an "
-            "Estimator (detected via is_device_flow) or call .sample(key) "
-            "inside jit"
+
+
+class DeviceLayerwiseFlow(DeviceGraphTables):
+    """On-device LADIES layer sampling (layerwise.py parity).
+
+    Each layer draw IS the exact host algorithm as XLA ops: candidate
+    incident weights scatter-add into an [N+1] vector, Gumbel top-k picks
+    `count` layer nodes without replacement (log w + Gumbel noise — the
+    store's layerwise_from_full recipe), and the dense batch→layer
+    adjacency is a [W, D, count] membership einsum, row-normalized. When
+    the whole frontier fits in `count` the layer is exact, like the host.
+    sample(key) returns the LayerwiseBatch `LayerwiseGCN` consumes (dense
+    in-flow-gathered features).
+    """
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        batch_size: int,
+        layer_sizes=(128, 128),
+        label_feature: str | None = None,
+        normalize: bool = True,
+        edge_types=None,
+        max_degree: int = 512,
+        roots_pool: np.ndarray | None = None,
+        root_node_type: int = -1,
+        mesh=None,
+    ):
+        super().__init__(
+            graph, edge_types, max_degree, roots_pool, root_node_type, mesh
         )
+        from euler_tpu.estimator.feature_cache import DeviceFeatureCache
+
+        self.batch_size = int(batch_size)
+        self.layer_sizes = [int(c) for c in layer_sizes]
+        self.normalize = bool(normalize)
+        self.feat_table = DeviceFeatureCache(graph, list(feature_names)).table
+        self.label_table = (
+            DeviceFeatureCache(graph, [label_feature]).table
+            if label_feature is not None
+            else None
+        )
+
+    def _sample_layer(self, cur, key, count: int):
+        """[W] rows → ([count] layer rows, f32[W, count] adjacency,
+        bool[count] layer mask)."""
+        nbr = self.adj[cur]  # [W, D]
+        w = (
+            self.wtab[cur]
+            if self.wtab is not None
+            else (nbr > 0).astype(jnp.float32)
+        )
+        wsum = (
+            jnp.zeros(self.num_nodes + 1)
+            .at[nbr.reshape(-1)]
+            .add(w.reshape(-1))
+            .at[0]
+            .set(0.0)
+        )
+        g = jax.random.gumbel(key, (self.num_nodes + 1,))
+        score = jnp.where(wsum > 0, jnp.log(wsum) + g, -jnp.inf)
+        top, layer = jax.lax.top_k(score, count)
+        lmask = top > -jnp.inf
+        layer = jnp.where(lmask, layer, 0).astype(jnp.int32)
+        hit = (nbr[:, :, None] == layer[None, None, :]) & (
+            layer[None, None, :] > 0
+        )
+        adj = jnp.einsum("wd,wdc->wc", w, hit.astype(w.dtype))
+        if self.normalize:
+            adj = adj / jnp.maximum(adj.sum(axis=1, keepdims=True), 1e-9)
+        return layer, adj, lmask
+
+    def sample(self, key) -> "LayerwiseBatch":
+        from euler_tpu.dataflow.layerwise import LayerwiseBatch
+
+        keys = jax.random.split(key, 1 + len(self.layer_sizes))
+        cur = self._dp(self._draw_roots(keys[0], self.batch_size))
+        layer_rows = [cur]
+        layer_masks = [cur > 0]
+        adjs = []
+        for count, lk in zip(self.layer_sizes, keys[1:]):
+            layer, adj, lmask = self._sample_layer(cur, lk, count)
+            adjs.append(self._dp(adj))
+            cur = self._dp(layer)
+            layer_rows.append(cur)
+            layer_masks.append(lmask)
+        feats = tuple(self._dp(self.feat_table[rw]) for rw in layer_rows)
+        labels = (
+            self._dp(self.label_table[layer_rows[0]])
+            if self.label_table is not None
+            else None
+        )
+        return LayerwiseBatch(
+            feats=feats,
+            masks=tuple(layer_masks),
+            adjs=tuple(adjs),
+            root_idx=self._dp(self.node_id[layer_rows[0]]),
+            labels=labels,
+            hop_ids=tuple(self._dp(self.node_id[rw]) for rw in layer_rows),
+        )
+

@@ -80,7 +80,9 @@ def build_parser():
     ap.add_argument("--device-flow", action="store_true",
                     help="sample batches ON the accelerator (HBM-resident "
                          "adjacency, zero per-step wire bytes) — conv "
-                         "models, deepwalk/node2vec/line, local graphs only")
+                         "models, graphsage_unsup, rgcn, fastgcn/"
+                         "adaptivegcn, deepwalk/node2vec/line, and the "
+                         "TransX family; local graphs only")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize conv layers on backward "
                          "(jax.checkpoint) — trades FLOPs for HBM on "
@@ -140,14 +142,16 @@ def main(argv=None):
     dims = [args.hidden_dim] * args.layers
     flow = None  # set by families that evaluate/infer through a dataflow
     if args.device_flow and not (
-        name in ("deepwalk", "node2vec", "line", "graphsage_unsup", "rgcn")
+        name in ("deepwalk", "node2vec", "line", "graphsage_unsup", "rgcn",
+                 "fastgcn", "adaptivegcn")
         or name in KG_MODELS
         or (name in CONV_MODELS and CONV_MODELS[name])
     ):
         raise SystemExit(
             f"--device-flow is not implemented for model {name!r} (conv "
-            "models, graphsage_unsup, rgcn, deepwalk/node2vec/line, and "
-            "the TransX family only) — rerun without the flag"
+            "models, graphsage_unsup, rgcn, fastgcn/adaptivegcn, "
+            "deepwalk/node2vec/line, and the TransX family only) — rerun "
+            "without the flag"
         )
 
     # ---- family dispatch -------------------------------------------------
@@ -225,10 +229,17 @@ def main(argv=None):
             label_feature="label", rng=rng,
         )
         model = LayerwiseGCN(dims=dims, label_dim=label_dim)
-        est = Estimator(
-            model, node_batches(graph, flow, args.batch_size, 0, rng=rng),
-            cfg, mesh=mesh,
-        )
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceLayerwiseFlow
+
+            bf = DeviceLayerwiseFlow(
+                graph, [feature], batch_size=args.batch_size,
+                layer_sizes=[64] * args.layers, label_feature="label",
+                root_node_type=0, mesh=mesh,
+            )
+        else:
+            bf = node_batches(graph, flow, args.batch_size, 0, rng=rng)
+        est = Estimator(model, bf, cfg, mesh=mesh)
     elif name == "rgcn":
         from euler_tpu.dataflow import RelationDataFlow
         from euler_tpu.models import RGCNSupervised

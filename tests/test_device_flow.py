@@ -627,6 +627,46 @@ def test_relation_flow_typed_draws_and_training(tmp_path):
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
 
 
+def test_layerwise_flow_exact_when_frontier_fits(graph, tmp_path):
+    """DeviceLayerwiseFlow: when the frontier fits in `count` the layer
+    is EXACT (host layerwise_from_full contract) — every frontier node
+    appears, the adjacency rows hold the true (normalized) weights, and
+    the batch trains LayerwiseGCN."""
+    from euler_tpu.dataflow import DeviceLayerwiseFlow
+    from euler_tpu.models import LayerwiseGCN
+
+    flow = DeviceLayerwiseFlow(
+        g0 := graph, ["feat"], batch_size=4, layer_sizes=[64, 64],
+        label_feature="label",
+    )
+    mb = jax.jit(flow.sample)(jax.random.PRNGKey(0))
+    roots = np.asarray(mb.hop_ids[0]).astype(np.uint64)  # already ids
+    layer = np.asarray(mb.hop_ids[1])
+    lmask = np.asarray(mb.masks[1])
+    nbr, _, _, m, _ = g0.get_full_neighbor(roots)
+    frontier = set(np.unique(nbr[m]).tolist())
+    assert len(frontier) <= 64, "fixture must exercise the exact case"
+    assert frontier == set(int(x) for x in layer[lmask])
+    # adjacency rows: normalized true incident weights onto layer nodes
+    adj = np.asarray(mb.adjs[0])
+    for i in range(4):
+        truth = np.zeros(64)
+        for c, lid in enumerate(layer):
+            if lmask[c]:
+                truth[c] = (nbr[i][m[i]] == lid).sum()  # unit weights
+        if truth.sum() > 0:
+            truth = truth / truth.sum()
+        np.testing.assert_allclose(adj[i], truth, rtol=1e-5, atol=1e-6)
+    est = Estimator(
+        LayerwiseGCN(dims=[16, 16], label_dim=2), flow,
+        EstimatorConfig(model_dir=str(tmp_path / "lw"), learning_rate=0.05,
+                        log_steps=10**9, steps_per_call=4),
+    )
+    losses = est.train(total_steps=12, log=False, save=False)
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
 def test_partitioned_graph_staging(tmp_path):
     """Device flows stage from multi-shard local graphs: the shard-major
     row space must line up with DeviceFeatureCache's, and sampled
