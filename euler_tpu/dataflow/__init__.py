@@ -1,6 +1,8 @@
 from euler_tpu.dataflow.base import Block, DataFlow, MiniBatch, fanout_block  # noqa: F401
 from euler_tpu.dataflow.device import (  # noqa: F401
+    DeviceDgiFlow,
     DeviceEdgeFlow,
+    DeviceGaeFlow,
     DeviceGraphTables,
     DeviceKGFlow,
     DeviceLayerwiseFlow,

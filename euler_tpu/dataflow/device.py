@@ -890,3 +890,49 @@ class DeviceLayerwiseFlow(DeviceGraphTables):
             hop_ids=tuple(self._dp(self.node_id[rw]) for rw in layer_rows),
         )
 
+
+
+class DeviceGaeFlow(DeviceSageFlow):
+    """On-device (src, dst, neg) fanout triples for GAE/VGAE
+    (models/autoencoders.py `gae_batches` parity): src draws ∝ edge
+    weight through the shared edge-source CDF, dst is the drawn edge's
+    endpoint, neg is a global node draw; each gets its own fanout batch.
+    """
+
+    def __init__(self, graph, fanouts, batch_size, edge_types=None,
+                 max_degree: int = 512, mesh=None):
+        super().__init__(
+            graph, fanouts, batch_size, None, edge_types, max_degree,
+            mesh=mesh,
+        )
+        self._stage_edge_src_cdf()
+
+    def sample(self, key) -> tuple:
+        ksrc, kdst, kneg, k1, k2, k3 = jax.random.split(key, 6)
+        src = self._draw_edge_sources(ksrc, self.batch_size)
+        dst, _, _ = self._draw_neighbors(src, kdst, 1)
+        neg = self._draw_global_nodes(kneg, self.batch_size)
+        return (
+            self._fanout_batch(src, k1),
+            self._fanout_batch(dst, k2),
+            self._fanout_batch(neg, k3),
+        )
+
+
+class DeviceDgiFlow(DeviceSageFlow):
+    """On-device (real, corrupted) batches for DGI (`dgi_batches`
+    parity): corruption permutes the feature rows across the batch —
+    with rows-mode feats a row permutation IS the standard DGI feature
+    shuffle (hydration gathers the permuted rows into permuted dense
+    features)."""
+
+    def sample(self, key) -> tuple:
+        kmb, kperm = jax.random.split(key)
+        mb = super().sample(kmb)
+        perm_feats = tuple(
+            jax.random.permutation(pk, f)
+            for pk, f in zip(
+                jax.random.split(kperm, len(mb.feats)), mb.feats
+            )
+        )
+        return (mb, mb.replace(feats=perm_feats))

@@ -81,8 +81,8 @@ def build_parser():
                     help="sample batches ON the accelerator (HBM-resident "
                          "adjacency, zero per-step wire bytes) — conv "
                          "models, graphsage_unsup, rgcn, fastgcn/"
-                         "adaptivegcn, deepwalk/node2vec/line, and the "
-                         "TransX family; local graphs only")
+                         "adaptivegcn, gae/vgae/dgi, deepwalk/node2vec/"
+                         "line, and the TransX family; local graphs only")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize conv layers on backward "
                          "(jax.checkpoint) — trades FLOPs for HBM on "
@@ -143,15 +143,15 @@ def main(argv=None):
     flow = None  # set by families that evaluate/infer through a dataflow
     if args.device_flow and not (
         name in ("deepwalk", "node2vec", "line", "graphsage_unsup", "rgcn",
-                 "fastgcn", "adaptivegcn")
+                 "fastgcn", "adaptivegcn", "gae", "vgae", "dgi")
         or name in KG_MODELS
         or (name in CONV_MODELS and CONV_MODELS[name])
     ):
         raise SystemExit(
             f"--device-flow is not implemented for model {name!r} (conv "
-            "models, graphsage_unsup, rgcn, fastgcn/adaptivegcn, "
-            "deepwalk/node2vec/line, and the TransX family only) — rerun "
-            "without the flag"
+            "models, graphsage_unsup, rgcn, fastgcn/adaptivegcn, gae/vgae/"
+            "dgi, deepwalk/node2vec/line, and the TransX family only) — "
+            "rerun without the flag"
         )
 
     # ---- family dispatch -------------------------------------------------
@@ -274,20 +274,44 @@ def main(argv=None):
         model = GAE(
             dims=dims[:1], variational=(name == "vgae"), remat=args.remat
         )
-        est = Estimator(
-            model, gae_batches(graph, flow, args.batch_size, rng=rng), cfg,
-            mesh=mesh,
-        )
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceGaeFlow
+            from euler_tpu.estimator import DeviceFeatureCache
+
+            est = Estimator(
+                model,
+                DeviceGaeFlow(graph, fanouts=args.fanouts[:1],
+                              batch_size=args.batch_size, mesh=mesh),
+                cfg, mesh=mesh,
+                feature_cache=DeviceFeatureCache(graph, [feature]),
+            )
+        else:
+            est = Estimator(
+                model, gae_batches(graph, flow, args.batch_size, rng=rng),
+                cfg, mesh=mesh,
+            )
     elif name == "dgi":
         from euler_tpu.dataflow import SageDataFlow
         from euler_tpu.models import DGI, dgi_batches
 
         flow = SageDataFlow(graph, [feature], fanouts=args.fanouts[:1], rng=rng)
         model = DGI(dims=dims[:1], remat=args.remat)
-        est = Estimator(
-            model, dgi_batches(graph, flow, args.batch_size, rng=rng), cfg,
-            mesh=mesh,
-        )
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceDgiFlow
+            from euler_tpu.estimator import DeviceFeatureCache
+
+            est = Estimator(
+                model,
+                DeviceDgiFlow(graph, fanouts=args.fanouts[:1],
+                              batch_size=args.batch_size, mesh=mesh),
+                cfg, mesh=mesh,
+                feature_cache=DeviceFeatureCache(graph, [feature]),
+            )
+        else:
+            est = Estimator(
+                model, dgi_batches(graph, flow, args.batch_size, rng=rng),
+                cfg, mesh=mesh,
+            )
     elif name in ("scalable_gcn", "scalable_sage"):
         from euler_tpu.models import ScalableGNN, ScalableTrainer
 

@@ -667,6 +667,52 @@ def test_layerwise_flow_exact_when_frontier_fits(graph, tmp_path):
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
 
 
+def test_gae_and_dgi_flows(graph, tmp_path):
+    """DeviceGaeFlow: (src, dst, neg) triples where dst is a true
+    neighbor of src; DeviceDgiFlow: corrupted view is a permutation of
+    the real batch's feature rows. Both train their models."""
+    from euler_tpu.dataflow import DeviceDgiFlow, DeviceGaeFlow
+    from euler_tpu.models import DGI, GAE
+
+    gflow = DeviceGaeFlow(graph, fanouts=[4], batch_size=16)
+    src_mb, dst_mb, neg_mb = jax.jit(gflow.sample)(jax.random.PRNGKey(0))
+    ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+    src = ids[np.asarray(src_mb.feats[0]) - 1]
+    dst = ids[np.asarray(dst_mb.feats[0]) - 1]
+    nbr, _, _, m, _ = graph.get_full_neighbor(src)
+    for i in range(16):
+        assert int(dst[i]) in set(nbr[i][m[i]].tolist())
+    est = Estimator(
+        GAE(dims=[16]), gflow,
+        EstimatorConfig(model_dir=str(tmp_path / "gae"),
+                        learning_rate=0.05, log_steps=10**9,
+                        steps_per_call=4),
+        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+    )
+    losses = est.train(total_steps=8, log=False, save=False)
+    assert np.isfinite(losses).all()
+
+    dflow = DeviceDgiFlow(graph, fanouts=[4], batch_size=16)
+    real, fake = jax.jit(dflow.sample)(jax.random.PRNGKey(1))
+    for f_r, f_f in zip(real.feats, fake.feats):
+        assert sorted(np.asarray(f_r).tolist()) == sorted(
+            np.asarray(f_f).tolist()
+        ), "corruption must be a permutation of the real rows"
+    assert not all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(real.feats, fake.feats)
+    ), "corruption must actually shuffle"
+    est2 = Estimator(
+        DGI(dims=[16]), dflow,
+        EstimatorConfig(model_dir=str(tmp_path / "dgi"),
+                        learning_rate=0.05, log_steps=10**9,
+                        steps_per_call=4),
+        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+    )
+    losses = est2.train(total_steps=8, log=False, save=False)
+    assert np.isfinite(losses).all()
+
+
 def test_partitioned_graph_staging(tmp_path):
     """Device flows stage from multi-shard local graphs: the shard-major
     row space must line up with DeviceFeatureCache's, and sampled
