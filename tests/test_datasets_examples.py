@@ -74,6 +74,18 @@ def test_run_model_data_parallel(tmp_path):
     assert rc == 0 or rc is None
 
 
+def test_run_model_device_flow_with_mesh(tmp_path):
+    """--device-flow composed with --data-parallel through the CLI: the
+    on-device sampler's batches shard across the 8-device harness."""
+    rc = run_model([
+        "--model", "graphsage", "--dataset", "cora", "--synthetic",
+        "--total-steps", "2", "--batch-size", "16", "--hidden-dim", "8",
+        "--fanouts", "2", "2", "--model-dir", str(tmp_path),
+        "--data-parallel", "8", "--device-flow", "--log-steps", "1000",
+    ])
+    assert rc == 0 or rc is None
+
+
 def test_kg_evaluate_mode(tmp_path):
     for mode in ("train", "evaluate"):
         rc = run_model([
