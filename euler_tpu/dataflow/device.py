@@ -68,6 +68,18 @@ class DeviceGraphTables:
             "inside jit"
         )
 
+    @staticmethod
+    def _quantize_cdf(weights, what: str):
+        """f64 weights → device uint32 CDF (exact adjacent values where a
+        f32 cumsum over millions of entries would swallow small weights);
+        raises on an empty or zero-total distribution."""
+        cum = np.cumsum(np.asarray(weights, dtype=np.float64))
+        if cum.size == 0 or cum[-1] <= 0:
+            raise ValueError(f"{what} weights sum to zero")
+        return jax.device_put(
+            np.floor(cum / cum[-1] * np.float64(2**32 - 1)).astype(np.uint32)
+        )
+
     def __init__(
         self,
         graph,
@@ -107,6 +119,12 @@ class DeviceGraphTables:
                 "need local shards (remote graphs keep the host flows)"
             )
         ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+        self._stage_adjacency(graph, ids, edge_types, max_degree, stage_types)
+        self._stage_nodes(graph, ids, roots_pool, root_node_type)
+
+    def _stage_adjacency(
+        self, graph, ids, edge_types, max_degree: int, stage_types: bool
+    ):
         n = len(ids)
         dmax = int(graph.max_degree(ids, edge_types))
         if dmax > max_degree:
@@ -161,6 +179,10 @@ class DeviceGraphTables:
         # cancellation from storing cumulative sums
         self.wtab = None if unit_w else jax.device_put(wtab)
         self.ttab = jax.device_put(ttab) if ttab is not None else None
+        self.max_deg = dmax
+
+    def _stage_nodes(self, graph, ids, roots_pool, root_node_type: int):
+        n = len(ids)
         # weight-proportional root draws (host sample_node parity): a
         # uint32-quantized CDF, binary-searched on device — over all nodes,
         # or over roots_pool's members when a pool restricts the draw.
@@ -172,16 +194,11 @@ class DeviceGraphTables:
         # global (unrestricted) node CDF — negative sampling draws from
         # ALL nodes even when roots are pool/type-restricted (host
         # unsupervised_batches neg_type=-1 parity)
-        self.global_cdf = None
-        if wn.size and not np.all(wn == wn[0]):
-            gcum = np.cumsum(wn)
-            if gcum[-1] <= 0:
-                raise ValueError("graph node weights sum to zero")
-            self.global_cdf = jax.device_put(
-                np.floor(gcum / gcum[-1] * np.float64(2**32 - 1)).astype(
-                    np.uint32
-                )
-            )
+        self.global_cdf = (
+            self._quantize_cdf(wn, "graph node")
+            if wn.size and not np.all(wn == wn[0])
+            else None
+        )
         pool_rows = None
         if roots_pool is not None:
             pool_rows = graph.lookup_rows(
@@ -200,16 +217,11 @@ class DeviceGraphTables:
                     f"no nodes of type {root_node_type} to sample roots from"
                 )
             wn = wn[pool_rows]
-        self.node_cdf = None
-        if wn.size and not np.all(wn == wn[0]):
-            cum = np.cumsum(wn)
-            if cum[-1] <= 0:
-                raise ValueError("root node weights sum to zero")
-            self.node_cdf = jax.device_put(
-                np.floor(cum / cum[-1] * np.float64(2**32 - 1)).astype(
-                    np.uint32
-                )
-            )
+        self.node_cdf = (
+            self._quantize_cdf(wn, "root node")
+            if wn.size and not np.all(wn == wn[0])
+            else None
+        )
         # int32 view of the u64 id space (host flows apply the same
         # truncation); index 0 (padding) maps to -1
         node_id = np.full(n + 1, -1, dtype=np.int32)
@@ -221,7 +233,6 @@ class DeviceGraphTables:
             else None
         )
         self.num_nodes = n
-        self.max_deg = dmax
 
     # -- traced draw primitives ------------------------------------------
 
@@ -303,11 +314,8 @@ class DeviceGraphTables:
         it and then a neighbor within the row draws an edge ∝ weight
         (P(e) = strength(src)/W · w(e)/strength(src) = w(e)/W — the host
         sample_edge alias-table distribution)."""
-        cum = np.cumsum(self._out_strength[1:])
-        if cum.size == 0 or cum[-1] <= 0:
-            raise ValueError("graph has no sampleable edges")
-        self.edge_src_cdf = jax.device_put(
-            np.floor(cum / cum[-1] * np.float64(2**32 - 1)).astype(np.uint32)
+        self.edge_src_cdf = self._quantize_cdf(
+            self._out_strength[1:], "edge-source out-strength"
         )
 
     def _draw_edge_sources(self, key, count: int):
@@ -643,12 +651,15 @@ class DeviceKGFlow(DeviceGraphTables):
     """On-device (h, r, t) triple sampling + corrupted negatives for the
     TransX family (models/kg.py `kg_batches` parity).
 
-    Edges draw ∝ weight via the same source-strength × within-row
-    factorization as `DeviceEdgeFlow`; the drawn slot's relation id comes
-    from a staged edge-type table (the `tt` plane of get_full_neighbor,
-    compacted alongside the adjacency). Corrupted heads/tails draw from
-    the global node CDF (host sample_node(-1) parity). `sample(key)`
-    returns the exact dict batch `TransX.__call__` consumes.
+    KG graphs are exactly the power-law case where a padded [N, Dmax]
+    adjacency is the wrong layout (FB15k hub entities have thousands of
+    out-edges), so this flow stages the FLAT edge list instead: int32
+    (h, r, t) columns (12 bytes/edge — 6 MB for FB15k's 483k triples)
+    plus a uint32-quantized edge-weight CDF when weights vary. An edge
+    draw is ONE searchsorted (or randint) over E — exact, no degree
+    guard, any degree distribution. Corrupted heads/tails draw from the
+    global node CDF (host sample_node(-1) parity). `sample(key)` returns
+    the exact dict batch `TransX.__call__` consumes.
     """
 
     def __init__(
@@ -656,36 +667,71 @@ class DeviceKGFlow(DeviceGraphTables):
         graph,
         batch_size: int,
         num_negs: int = 8,
-        edge_types=None,
-        max_degree: int = 512,
+        edge_type: int = -1,
         mesh=None,
     ):
-        super().__init__(
-            graph, edge_types, max_degree, mesh=mesh, stage_types=True
-        )
+        self.mesh = mesh
         self.batch_size = int(batch_size)
         self.num_negs = int(num_negs)
-        self._stage_edge_src_cdf()
+        if not all(
+            hasattr(s, "edge_src") and hasattr(s, "node_weights")
+            for s in graph.shards
+        ):
+            raise ValueError(
+                "DeviceKGFlow stages the flat edge list host-side and "
+                "needs local shards (remote graphs keep kg_batches)"
+            )
+        ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+        h = np.concatenate([np.asarray(s.edge_src) for s in graph.shards])
+        t = np.concatenate([np.asarray(s.edge_dst) for s in graph.shards])
+        r = np.concatenate([np.asarray(s.edge_types) for s in graph.shards])
+        w = np.concatenate(
+            [np.asarray(s.edge_weights, np.float64) for s in graph.shards]
+        )
+        if edge_type >= 0:
+            keep = r == edge_type
+            h, t, r, w = h[keep], t[keep], r[keep], w[keep]
+        if len(h) == 0:
+            raise ValueError("graph has no sampleable edges")
+        to32 = lambda x: x.astype(np.int64).astype(np.int32)  # noqa: E731
+        self.eh = jax.device_put(to32(h))
+        self.et = jax.device_put(to32(t))
+        self.er = jax.device_put(r.astype(np.int32))
+        self.num_edges = len(h)
+        if np.sum(w) <= 0:
+            # host sample_edge parity: an all-zero-weight edge set is
+            # unsampleable even though the weights are all equal
+            raise ValueError("edge weights sum to zero")
+        self.edge_cdf = (
+            None if np.all(w == w[0]) else self._quantize_cdf(w, "edge")
+        )
+        self._stage_nodes(graph, ids, None, -1)
 
     def sample(self, key) -> dict:
         """key → TransX batch dict, jit-traceable."""
-        ksrc, kdst, kneg = jax.random.split(key, 3)
-        h = self._draw_edge_sources(ksrc, self.batch_size)
-        t, _, idx = self._draw_neighbors(h, kdst, 1)
-        rel = self.ttab[h[:, None], idx].reshape(-1)
+        kedge, kneg = jax.random.split(key)
+        if self.edge_cdf is not None:
+            rb = jax.random.bits(kedge, (self.batch_size,), dtype=jnp.uint32)
+            pick = jnp.minimum(
+                jnp.searchsorted(self.edge_cdf, rb, side="right"),
+                self.num_edges - 1,
+            )
+        else:
+            pick = jax.random.randint(
+                kedge, (self.batch_size,), 0, self.num_edges
+            )
         negs = self.node_id[
             self._draw_global_nodes(
                 kneg, self.batch_size * self.num_negs * 2
             )
         ].reshape(2, self.batch_size, self.num_negs)
         return {
-            "h": self._dp(self.node_id[h]),
-            "r": self._dp(rel),
-            "t": self._dp(self.node_id[t]),
+            "h": self._dp(self.eh[pick]),
+            "r": self._dp(self.er[pick]),
+            "t": self._dp(self.et[pick]),
             "neg_h": self._dp(negs[0]),
             "neg_t": self._dp(negs[1]),
         }
-
 
 
 class DeviceRelationFlow(DeviceGraphTables):
