@@ -80,6 +80,55 @@ class DeviceGraphTables:
             np.floor(cum / cum[-1] * np.float64(2**32 - 1)).astype(np.uint32)
         )
 
+    def _stage_flat_edges(self, graph, edge_type: int = -1,
+                          stage_er: bool = False):
+        """Stage the flat (src, [type,] dst) edge columns + a weight CDF —
+        the right layout for whole-edge draws on any degree distribution
+        (8-12 bytes/edge, one searchsorted per draw, no max_degree
+        guard). Edges with endpoints absent from the node table are
+        dropped (the padded-adjacency path collapsed them to masked
+        padding; flat staging must not emit them as real samples). Sets
+        eh/et (int32, host id-truncation parity), er when stage_er (KG
+        relations; LINE never reads it), num_edges, and edge_cdf (None
+        when weights are uniform)."""
+        if not all(hasattr(s, "edge_src") for s in graph.shards):
+            raise ValueError(
+                "flat edge staging needs local shards with edge columns "
+                "(remote graphs keep the host batch sources)"
+            )
+        h = np.concatenate([np.asarray(s.edge_src) for s in graph.shards])
+        t = np.concatenate([np.asarray(s.edge_dst) for s in graph.shards])
+        r = np.concatenate([np.asarray(s.edge_types) for s in graph.shards])
+        w = np.concatenate(
+            [np.asarray(s.edge_weights, np.float64) for s in graph.shards]
+        )
+        keep = (graph.lookup_rows(h) >= 0) & (graph.lookup_rows(t) >= 0)
+        if edge_type >= 0:
+            keep &= r == edge_type
+        h, t, r, w = h[keep], t[keep], r[keep], w[keep]
+        if len(h) == 0 or np.sum(w) <= 0:
+            # host sample_edge parity: empty or all-zero-weight edge
+            # sets are unsampleable even when the weights are all equal
+            raise ValueError("graph has no sampleable edges")
+        to32 = lambda x: x.astype(np.int64).astype(np.int32)  # noqa: E731
+        self.eh = jax.device_put(to32(h))
+        self.et = jax.device_put(to32(t))
+        self.er = jax.device_put(r.astype(np.int32)) if stage_er else None
+        self.num_edges = len(h)
+        self.edge_cdf = (
+            None if np.all(w == w[0]) else self._quantize_cdf(w, "edge")
+        )
+
+    def _draw_edges(self, key, count: int):
+        """[count] indices into the staged flat edge list, ∝ weight."""
+        if self.edge_cdf is not None:
+            rb = jax.random.bits(key, (count,), dtype=jnp.uint32)
+            return jnp.minimum(
+                jnp.searchsorted(self.edge_cdf, rb, side="right"),
+                self.num_edges - 1,
+            )
+        return jax.random.randint(key, (count,), 0, self.num_edges)
+
     def __init__(
         self,
         graph,
@@ -604,122 +653,75 @@ class DeviceWalkFlow(DeviceGraphTables):
 
 
 
-class DeviceEdgeFlow(DeviceGraphTables):
+class _FlatEdgeFlow(DeviceGraphTables):
+    """Shared staging for flows that draw whole edges from the flat list
+    (LINE, KG): edge columns + weight CDF + node tables for negatives."""
+
+    def __init__(self, graph, batch_size: int, num_negs: int,
+                 edge_type: int = -1, mesh=None, stage_er: bool = False):
+        self.mesh = mesh
+        self.batch_size = int(batch_size)
+        self.num_negs = int(num_negs)
+        self._stage_flat_edges(graph, edge_type, stage_er=stage_er)
+        ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+        self._stage_nodes(graph, ids, None, -1)
+
+
+class DeviceEdgeFlow(_FlatEdgeFlow):
     """On-device weighted edge sampling for LINE (examples/line parity).
 
     Replaces the host `line_batches` source (graph.sample_edge +
-    sample_node negatives, models/embedding_models.py): an edge drawn
-    ∝ weight factors into source ∝ out-strength (uint32-quantized CDF)
-    times neighbor-within-row (the shared `_draw_neighbors` CDF draw) —
-    P(e) = strength(src)/Σstrength · w(e)/strength(src) = w(e)/W, the
-    same distribution the host _WeightedSampler draws from the flat edge
-    list. `sample(key)` returns the SkipGramModel dict batch.
+    sample_node negatives, models/embedding_models.py). Stages the FLAT
+    edge list — the right layout for whole-edge draws on any degree
+    distribution (no max_degree guard; power-law graphs welcome) — and
+    draws each edge with one searchsorted over the weight CDF, the same
+    distribution the host alias tables sample. `sample(key)` returns the
+    SkipGramModel dict batch.
     """
 
-    def __init__(
-        self,
-        graph,
-        batch_size: int,
-        num_negs: int = 5,
-        edge_types=None,
-        max_degree: int = 512,
-        mesh=None,
-    ):
-        super().__init__(graph, edge_types, max_degree, mesh=mesh)
-        self.batch_size = int(batch_size)
-        self.num_negs = int(num_negs)
-        self._stage_edge_src_cdf()
+    def __init__(self, graph, batch_size: int, num_negs: int = 5,
+                 edge_type: int = -1, mesh=None):
+        super().__init__(graph, batch_size, num_negs, edge_type, mesh)
 
     def sample(self, key) -> dict:
         """key → SkipGramModel batch dict, jit-traceable."""
-        ksrc, kdst, kneg = jax.random.split(key, 3)
-        src = self._draw_edge_sources(ksrc, self.batch_size)
-        dst, _, _ = self._draw_neighbors(src, kdst, 1)
+        kedge, kneg = jax.random.split(key)
+        pick = self._draw_edges(kedge, self.batch_size)
         negs = self._draw_global_nodes(kneg, self.batch_size * self.num_negs)
         return {
-            "src": self._dp(self.node_id[src]),
-            "pos": self._dp(self.node_id[dst]),
+            "src": self._dp(self.eh[pick]),
+            "pos": self._dp(self.et[pick]),
             "negs": self._dp(
                 self.node_id[negs].reshape(-1, self.num_negs)
             ),
-            "mask": self._dp(dst > 0),
+            "mask": self._dp(jnp.ones(self.batch_size, bool)),
         }
 
 
-
-class DeviceKGFlow(DeviceGraphTables):
+class DeviceKGFlow(_FlatEdgeFlow):
     """On-device (h, r, t) triple sampling + corrupted negatives for the
     TransX family (models/kg.py `kg_batches` parity).
 
     KG graphs are exactly the power-law case where a padded [N, Dmax]
     adjacency is the wrong layout (FB15k hub entities have thousands of
-    out-edges), so this flow stages the FLAT edge list instead: int32
-    (h, r, t) columns (12 bytes/edge — 6 MB for FB15k's 483k triples)
-    plus a uint32-quantized edge-weight CDF when weights vary. An edge
-    draw is ONE searchsorted (or randint) over E — exact, no degree
-    guard, any degree distribution. Corrupted heads/tails draw from the
-    global node CDF (host sample_node(-1) parity). `sample(key)` returns
-    the exact dict batch `TransX.__call__` consumes.
+    out-edges), so this flow stages the FLAT edge list (shared
+    `_stage_flat_edges`: int32 (h, r, t) columns, 12 bytes/edge — 6 MB
+    for FB15k's 483k triples — one searchsorted per draw, exact, any
+    degree distribution). Corrupted heads/tails draw from the global
+    node CDF (host sample_node(-1) parity). `sample(key)` returns the
+    exact dict batch `TransX.__call__` consumes.
     """
 
-    def __init__(
-        self,
-        graph,
-        batch_size: int,
-        num_negs: int = 8,
-        edge_type: int = -1,
-        mesh=None,
-    ):
-        self.mesh = mesh
-        self.batch_size = int(batch_size)
-        self.num_negs = int(num_negs)
-        if not all(
-            hasattr(s, "edge_src") and hasattr(s, "node_weights")
-            for s in graph.shards
-        ):
-            raise ValueError(
-                "DeviceKGFlow stages the flat edge list host-side and "
-                "needs local shards (remote graphs keep kg_batches)"
-            )
-        ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
-        h = np.concatenate([np.asarray(s.edge_src) for s in graph.shards])
-        t = np.concatenate([np.asarray(s.edge_dst) for s in graph.shards])
-        r = np.concatenate([np.asarray(s.edge_types) for s in graph.shards])
-        w = np.concatenate(
-            [np.asarray(s.edge_weights, np.float64) for s in graph.shards]
+    def __init__(self, graph, batch_size: int, num_negs: int = 8,
+                 edge_type: int = -1, mesh=None):
+        super().__init__(
+            graph, batch_size, num_negs, edge_type, mesh, stage_er=True
         )
-        if edge_type >= 0:
-            keep = r == edge_type
-            h, t, r, w = h[keep], t[keep], r[keep], w[keep]
-        if len(h) == 0:
-            raise ValueError("graph has no sampleable edges")
-        to32 = lambda x: x.astype(np.int64).astype(np.int32)  # noqa: E731
-        self.eh = jax.device_put(to32(h))
-        self.et = jax.device_put(to32(t))
-        self.er = jax.device_put(r.astype(np.int32))
-        self.num_edges = len(h)
-        if np.sum(w) <= 0:
-            # host sample_edge parity: an all-zero-weight edge set is
-            # unsampleable even though the weights are all equal
-            raise ValueError("edge weights sum to zero")
-        self.edge_cdf = (
-            None if np.all(w == w[0]) else self._quantize_cdf(w, "edge")
-        )
-        self._stage_nodes(graph, ids, None, -1)
 
     def sample(self, key) -> dict:
         """key → TransX batch dict, jit-traceable."""
         kedge, kneg = jax.random.split(key)
-        if self.edge_cdf is not None:
-            rb = jax.random.bits(kedge, (self.batch_size,), dtype=jnp.uint32)
-            pick = jnp.minimum(
-                jnp.searchsorted(self.edge_cdf, rb, side="right"),
-                self.num_edges - 1,
-            )
-        else:
-            pick = jax.random.randint(
-                kedge, (self.batch_size,), 0, self.num_edges
-            )
+        pick = self._draw_edges(kedge, self.batch_size)
         negs = self.node_id[
             self._draw_global_nodes(
                 kneg, self.batch_size * self.num_negs * 2
