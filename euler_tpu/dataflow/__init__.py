@@ -10,6 +10,7 @@ from euler_tpu.dataflow.device import (  # noqa: F401
     DeviceSageFlow,
     DeviceUnsupSageFlow,
     DeviceWalkFlow,
+    DeviceWholeGraphFlow,
 )
 from euler_tpu.dataflow.sage import FullNeighborDataFlow, SageDataFlow  # noqa: F401
 from euler_tpu.dataflow.walk import gen_pair  # noqa: F401

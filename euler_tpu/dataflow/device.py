@@ -102,7 +102,8 @@ class DeviceGraphTables:
         w = np.concatenate(
             [np.asarray(s.edge_weights, np.float64) for s in graph.shards]
         )
-        keep = (graph.lookup_rows(h) >= 0) & (graph.lookup_rows(t) >= 0)
+        rows_ht = graph.lookup_rows(np.concatenate([h, t]))
+        keep = (rows_ht[: len(h)] >= 0) & (rows_ht[len(h) :] >= 0)
         if edge_type >= 0:
             keep &= r == edge_type
         h, t, r, w = h[keep], t[keep], r[keep], w[keep]
@@ -215,7 +216,7 @@ class DeviceGraphTables:
         # a positive-degree row whose weights are all zero is unsampleable
         # (host _WeightedSampler semantics: zero total → padding)
         # per-node out-strength (edge-weight row sums): zero-strength rows
-        # are unsampleable, and DeviceEdgeFlow draws edge sources ∝ it
+        # are unsampleable, and DeviceGaeFlow draws edge sources ∝ it
         strength = wtab.sum(axis=1, dtype=np.float64)
         deg[strength <= 0.0] = 0
         self._out_strength = strength
@@ -990,3 +991,102 @@ class DeviceDgiFlow(DeviceSageFlow):
             )
         )
         return (mb, mb.replace(feats=perm_feats))
+
+
+class DeviceWholeGraphFlow(DeviceGraphTables):
+    """Dataset-on-device whole-graph batches for graph classification
+    (whole.py `WholeGraphDataFlow` + `graph_label_batches` parity).
+
+    Graph-classification datasets are small (every labeled graph padded
+    to max_nodes × max_degree), so the entire padded dataset stages into
+    HBM once — per-graph feature/mask/edge/label tensors stacked along a
+    leading graph axis — and a training batch is a uniform label draw
+    (host sample_graph_label parity) plus gathers, with edge indices
+    offset into the batch's flattened node table. Staging reuses the
+    host flow's padding/slot logic by querying it one label at a time.
+    """
+
+    def __init__(
+        self,
+        graph,
+        feature_names,
+        batch_size: int,
+        max_nodes: int = 32,
+        max_degree: int = 8,
+        edge_types=None,
+        mesh=None,
+        host_flow=None,
+    ):
+        """host_flow: an already-built WholeGraphDataFlow to stage from
+        (its max_nodes/max_degree then govern the padding — callers that
+        also evaluate through the host flow pass it to keep one source
+        of truth); built internally otherwise."""
+        from euler_tpu.dataflow.whole import WholeGraphDataFlow
+
+        self.mesh = mesh
+        self.batch_size = int(batch_size)
+        host = host_flow or WholeGraphDataFlow(
+            graph, feature_names, max_nodes=max_nodes,
+            max_degree=max_degree, edge_types=edge_types,
+        )
+        if host.num_labels == 0:
+            raise ValueError("graph has no graph labels to sample")
+        self.num_classes = host.num_classes
+        ng, nmax = host.num_labels, host.max_nodes
+        # ONE batched host query stages every labeled graph; per-graph
+        # tensors are reshaped slices (the host's i*nmax edge offsets are
+        # subtracted here and re-added per batch slot in sample())
+        all_b = host.query(np.arange(ng))
+        put = jax.device_put
+        self.gfeats = put(np.asarray(all_b.feats).reshape(ng, nmax, -1))
+        self.gmask = put(np.asarray(all_b.node_mask).reshape(ng, nmax))
+        self.grid = int(all_b.block.grid)
+        e = nmax * self.grid
+        local = np.arange(ng, dtype=np.int32)[:, None] * nmax
+        emask = np.asarray(all_b.block.mask).reshape(ng, e)
+        # masked padding edges carry global slot 0 in the host layout;
+        # localize them to 0 (not -i*nmax) so the batch offset re-added in
+        # sample() can never go negative
+        self.gesrc = put(np.where(
+            emask, np.asarray(all_b.block.edge_src).reshape(ng, e) - local, 0
+        ).astype(np.int32))
+        # dst is the aggregation center — structurally valid for masked
+        # edges too, so plain localization stays in [0, nmax)
+        self.gedst = put(
+            (np.asarray(all_b.block.edge_dst).reshape(ng, e) - local).astype(
+                np.int32
+            )
+        )
+        self.gew = put(np.asarray(all_b.block.edge_w).reshape(ng, e))
+        self.gemask = put(emask)
+        self.glabels = put(np.asarray(all_b.labels))
+        self.ghop = put(np.asarray(all_b.hop_ids).reshape(ng, nmax))
+        self.nmax = nmax
+        self.num_graphs = ng
+
+    def sample(self, key) -> "GraphBatch":
+        from euler_tpu.dataflow.whole import GraphBatch
+
+        b, nmax = self.batch_size, self.nmax
+        pick = jax.random.randint(key, (b,), 0, self.num_graphs)
+        off_n = (jnp.arange(b, dtype=jnp.int32) * nmax)[:, None]
+        block = Block(
+            edge_src=self._dp((self.gesrc[pick] + off_n).reshape(-1)),
+            edge_dst=self._dp((self.gedst[pick] + off_n).reshape(-1)),
+            edge_w=self._dp(self.gew[pick].reshape(-1)),
+            mask=self._dp(self.gemask[pick].reshape(-1)),
+            n_src=b * nmax,
+            n_dst=b * nmax,
+            grid=self.grid,
+        )
+        return GraphBatch(
+            feats=self._dp(self.gfeats[pick].reshape(b * nmax, -1)),
+            node_mask=self._dp(self.gmask[pick].reshape(-1)),
+            block=block,
+            graph_ids=self._dp(
+                jnp.repeat(jnp.arange(b, dtype=jnp.int32), nmax)
+            ),
+            labels=self._dp(self.glabels[pick]),
+            hop_ids=self._dp(self.ghop[pick].reshape(-1)),
+            n_graphs=b,
+        )

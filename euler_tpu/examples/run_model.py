@@ -81,8 +81,9 @@ def build_parser():
                     help="sample batches ON the accelerator (HBM-resident "
                          "adjacency, zero per-step wire bytes) — conv "
                          "models, graphsage_unsup, rgcn, fastgcn/"
-                         "adaptivegcn, gae/vgae/dgi, deepwalk/node2vec/"
-                         "line, and the TransX family; local graphs only")
+                         "adaptivegcn, gae/vgae/dgi, graph classification, "
+                         "deepwalk/node2vec/line, and the TransX family; "
+                         "local graphs only")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize conv layers on backward "
                          "(jax.checkpoint) — trades FLOPs for HBM on "
@@ -145,13 +146,14 @@ def main(argv=None):
         name in ("deepwalk", "node2vec", "line", "graphsage_unsup", "rgcn",
                  "fastgcn", "adaptivegcn", "gae", "vgae", "dgi")
         or name in KG_MODELS
+        or name in GRAPH_CLF
         or (name in CONV_MODELS and CONV_MODELS[name])
     ):
         raise SystemExit(
-            f"--device-flow is not implemented for model {name!r} (conv "
-            "models, graphsage_unsup, rgcn, fastgcn/adaptivegcn, gae/vgae/"
-            "dgi, deepwalk/node2vec/line, and the TransX family only) — "
-            "rerun without the flag"
+            f"--device-flow is not implemented for model {name!r} — it "
+            "covers conv models, graphsage_unsup, rgcn, fastgcn/"
+            "adaptivegcn, gae/vgae/dgi, graph classification, deepwalk/"
+            "node2vec/line, and the TransX family; rerun without the flag"
         )
 
     # ---- family dispatch -------------------------------------------------
@@ -216,10 +218,16 @@ def main(argv=None):
             num_classes=max(flow.num_classes, 2), pool=pool,
             remat=args.remat,
         )
-        est = Estimator(
-            model, graph_label_batches(graph, flow, args.batch_size, rng=rng),
-            cfg, mesh=mesh,
-        )
+        if args.device_flow:
+            from euler_tpu.dataflow import DeviceWholeGraphFlow
+
+            bf = DeviceWholeGraphFlow(
+                graph, [feature], batch_size=args.batch_size,
+                mesh=mesh, host_flow=flow,
+            )
+        else:
+            bf = graph_label_batches(graph, flow, args.batch_size, rng=rng)
+        est = Estimator(model, bf, cfg, mesh=mesh)
     elif name in ("fastgcn", "adaptivegcn"):
         from euler_tpu.dataflow import LayerwiseDataFlow
         from euler_tpu.models import LayerwiseGCN

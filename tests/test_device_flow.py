@@ -713,6 +713,55 @@ def test_gae_and_dgi_flows(graph, tmp_path):
     assert np.isfinite(losses).all()
 
 
+def test_whole_graph_flow_matches_host_batches(tmp_path):
+    """DeviceWholeGraphFlow: a drawn graph's slice must EQUAL the host
+    flow's query for the same label (same padding/slot logic), and the
+    batch trains GraphClassifier."""
+    from euler_tpu.dataflow import DeviceWholeGraphFlow, WholeGraphDataFlow
+    from euler_tpu.datasets.catalog import get_dataset
+    from euler_tpu.models import GraphClassifier
+
+    g = get_dataset("mutag").load_graph(synthetic=True)
+    host = WholeGraphDataFlow(g, ["feature"], max_nodes=16, max_degree=8)
+    flow = DeviceWholeGraphFlow(g, ["feature"], batch_size=4,
+                                max_nodes=16, max_degree=8)
+    assert flow.num_classes == host.num_classes
+    mb = jax.jit(flow.sample)(jax.random.PRNGKey(0))
+    assert mb.n_graphs == 4 and mb.feats.shape[0] == 64
+    # reconstruct which labels were drawn via the staged label rows
+    labels = np.asarray(mb.labels)
+    hop = np.asarray(mb.hop_ids).reshape(4, 16)
+    staged_hop = np.asarray(flow.ghop)
+    for i in range(4):
+        matches = np.nonzero((staged_hop == hop[i]).all(axis=1))[0]
+        assert len(matches) >= 1
+        gid = int(matches[0])
+        ref = host.query(np.array([gid]))
+        np.testing.assert_array_equal(hop[i], np.asarray(ref.hop_ids))
+        np.testing.assert_allclose(
+            labels[i], np.asarray(ref.labels[0]), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(mb.feats).reshape(4, 16, -1)[i],
+            np.asarray(ref.feats), rtol=1e-6,
+        )
+        # edge indices offset into the batch table by i*16
+        e = 16 * int(flow.grid)
+        np.testing.assert_array_equal(
+            np.asarray(mb.block.edge_src).reshape(4, e)[i] - i * 16,
+            np.asarray(ref.block.edge_src),
+        )
+    est = Estimator(
+        GraphClassifier(conv="gin", dims=(16, 16),
+                        num_classes=flow.num_classes, pool="mean"),
+        flow,
+        EstimatorConfig(model_dir=str(tmp_path / "wg"), learning_rate=0.05,
+                        log_steps=10**9, steps_per_call=4),
+    )
+    losses = est.train(total_steps=8, log=False, save=False)
+    assert np.isfinite(losses).all()
+
+
 def test_partitioned_graph_staging(tmp_path):
     """Device flows stage from multi-shard local graphs: the shard-major
     row space must line up with DeviceFeatureCache's, and sampled
