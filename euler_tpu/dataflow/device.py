@@ -426,12 +426,19 @@ class DeviceSageFlow(DeviceGraphTables):
         roots_pool: np.ndarray | None = None,
         root_node_type: int = -1,
         mesh=None,
+        with_hop_ids: bool = False,
     ):
+        """with_hop_ids=True ships per-hop int32 node ids in the batch —
+        what id-embedding models (ShallowEncoder with max_id) consume.
+        The host LEAN wire must omit hop_ids (they cost wire bytes); on
+        device they are a free node_id gather, so id-embedding models
+        run through the device flow at no extra cost."""
         super().__init__(
             graph, edge_types, max_degree, roots_pool, root_node_type, mesh
         )
         self.fanouts = [int(k) for k in fanouts]
         self.batch_size = int(batch_size)
+        self.with_hop_ids = bool(with_hop_ids)
         if label_feature is not None:
             from euler_tpu.estimator.feature_cache import DeviceFeatureCache
 
@@ -471,7 +478,15 @@ class DeviceSageFlow(DeviceGraphTables):
             blocks=tuple(blocks),
             root_idx=self._dp(self.node_id[feats[0]]),
             labels=labels,
-            hop_ids=None,
+            # pad rows map to id -1 (host non-lean parity); the encoder
+            # clips them to 0, but hydrate_blocks derives hop masks from
+            # the rows-mode feats before the model applies, so pad-slot
+            # embeddings never reach the aggregation
+            hop_ids=(
+                tuple(self._dp(self.node_id[f]) for f in feats)
+                if self.with_hop_ids
+                else None
+            ),
         )
 
     def sample(self, key) -> MiniBatch:
@@ -504,10 +519,11 @@ class DeviceUnsupSageFlow(DeviceSageFlow):
         roots_pool: np.ndarray | None = None,
         root_node_type: int = -1,
         mesh=None,
+        with_hop_ids: bool = False,
     ):
         super().__init__(
             graph, fanouts, batch_size, None, edge_types, max_degree,
-            roots_pool, root_node_type, mesh,
+            roots_pool, root_node_type, mesh, with_hop_ids=with_hop_ids,
         )
         self.num_negs = int(num_negs)
 

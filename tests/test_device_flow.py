@@ -799,6 +799,56 @@ def test_partitioned_graph_staging(tmp_path):
     assert np.isfinite(losses).all()
 
 
+def test_hop_ids_enable_id_embedding_models(graph, tmp_path):
+    """with_hop_ids=True ships per-hop ids (free on device, unlike the
+    host lean wire), and an id-embedding model (ShallowEncoder) trains."""
+    from euler_tpu.dataflow.base import hydrate_blocks
+    from euler_tpu.dataflow import DeviceUnsupSageFlow
+    from euler_tpu.models import GraphSAGEUnsupervised
+
+    flow = DeviceSageFlow(graph, fanouts=[4, 3], batch_size=16,
+                          label_feature="label", with_hop_ids=True)
+    mb = jax.jit(flow.sample)(jax.random.PRNGKey(0))
+    assert mb.hop_ids is not None and len(mb.hop_ids) == 3
+    # pad-slot embeddings never reach the aggregation: hydration derives
+    # hop masks from the rows-mode feats (False exactly on pad rows)
+    hb = hydrate_blocks(mb)
+    for h in range(1, 3):
+        np.testing.assert_array_equal(
+            np.asarray(hb.masks[h]), np.asarray(mb.feats[h]) > 0
+        )
+    # the unsupervised subclass forwards the flag (id-embedding models)
+    uflow = DeviceUnsupSageFlow(graph, fanouts=[4], batch_size=8,
+                                with_hop_ids=True)
+    s_mb, _, _ = jax.jit(uflow.sample)(jax.random.PRNGKey(1))
+    assert s_mb.hop_ids is not None
+    uest = Estimator(
+        GraphSAGEUnsupervised(dims=[16], encoder_dim=8, max_id=300),
+        uflow,
+        EstimatorConfig(model_dir="/tmp/etpu_unsup_ids", learning_rate=0.05,
+                        log_steps=10**9, steps_per_call=2),
+        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+    )
+    ulosses = uest.train(total_steps=4, log=False, save=False)
+    assert np.isfinite(ulosses).all()
+    ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
+    # hop_ids are the ids of the sampled rows (pad rows map to -1)
+    rows = np.asarray(mb.feats[1])
+    expect = np.where(rows > 0, ids[np.maximum(rows - 1, 0)].astype(np.int64),
+                      -1).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(mb.hop_ids[1]), expect)
+    est = Estimator(
+        GraphSAGESupervised(dims=[16, 16], label_dim=2, encoder_dim=8,
+                            max_id=300),
+        flow,
+        EstimatorConfig(model_dir=str(tmp_path / "ids"), learning_rate=0.05,
+                        log_steps=10**9, steps_per_call=4),
+        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+    )
+    losses = est.train(total_steps=8, log=False, save=False)
+    assert np.isfinite(losses).all()
+
+
 def test_remainder_steps(graph, tmp_path):
     """total_steps not a multiple of steps_per_call exercises the
     single-step remainder path with sliced flow keys."""
