@@ -239,6 +239,16 @@ def run(platform: str) -> tuple[float, dict]:
     from euler_tpu.datasets.synthetic import random_graph
 
     on_cpu = platform == "cpu"
+    # EULER_BENCH_DEVICE_FLOW=1/0 forces the sampling path; by default
+    # sampling runs on device on an accelerator but stays on the host on
+    # CPU, where "device" sampling would just serialize with model compute
+    # on the same cores (measured: host 2.99M vs traced 2.18M edges/s on
+    # the 1-core fallback box). --smoke also defaults to the device flow
+    # so the production-default path stays smoke-covered.
+    env_df = os.environ.get("EULER_BENCH_DEVICE_FLOW")
+    _df_default = (env_df != "0") if env_df is not None else (
+        SMOKE or not on_cpu
+    )
     if SMOKE:
         num_nodes, out_degree, feat_dim = 2000, 10, 16
         batch_size, fanouts, dims = 64, [5, 5], [32, 32]
@@ -266,9 +276,13 @@ def run(platform: str) -> tuple[float, dict]:
         ]
         batch_size, fanouts = 1024, [10, 10]
         # EULER_BENCH_STEPS_PER_CALL: scan depth per dispatch — the lever
-        # that amortizes the tunnel's per-dispatch round trip (extras
-        # sweep: deeper scans when RTT dominates a run)
-        steps_per_call = int(os.environ.get("EULER_BENCH_STEPS_PER_CALL", 16))
+        # that amortizes the tunnel's per-dispatch round trip. Measured
+        # sweep on chip (artifacts/tpu_extras_r5): device flow 30.0M@16 →
+        # 37.4M@32 → 38.4M@64 edges/s, so the device-flow default is 64;
+        # the host path keeps 16 (its per-step host sampling cost sits
+        # outside the scan, so depth buys nothing there).
+        env_k = os.environ.get("EULER_BENCH_STEPS_PER_CALL")
+        steps_per_call = int(env_k) if env_k else (64 if _df_default else 16)
         warmup, steps = 2 * steps_per_call, 30 * steps_per_call
 
     rng = np.random.default_rng(0)
@@ -299,18 +313,9 @@ def run(platform: str) -> tuple[float, dict]:
     cache = DeviceFeatureCache(graph, ["feat"])
     bf16 = BF16 or (not on_cpu and "--fp32" not in sys.argv)
 
-    # EULER_BENCH_DEVICE_FLOW=1/0 forces the sampling path; the default
-    # samples on device on an accelerator — adjacency lives in HBM next
-    # to the features and the only per-step input is a PRNG key — but
-    # keeps the host path on CPU, where "device" sampling would just
-    # serialize with model compute on the same cores (measured: host
-    # 2.99M vs traced 2.18M edges/s on the 1-core fallback box)
-    env_df = os.environ.get("EULER_BENCH_DEVICE_FLOW")
-    # --smoke is a wiring check, not a measurement: default to the device
-    # flow there so the production-default path stays smoke-covered
-    device_flow = (
-        (env_df != "0") if env_df is not None else (SMOKE or not on_cpu)
-    )
+    # device flow: adjacency lives in HBM next to the features and the
+    # only per-step input is a PRNG key (see _df_default above)
+    device_flow = _df_default
     if device_flow:
         from euler_tpu.dataflow import DeviceSageFlow
 

@@ -1000,13 +1000,23 @@ class DeviceDgiFlow(DeviceSageFlow):
     def sample(self, key) -> tuple:
         kmb, kperm = jax.random.split(key)
         mb = super().sample(kmb)
-        perm_feats = tuple(
-            jax.random.permutation(pk, f)
+        # one permutation per hop, shared by the feature rows and (when
+        # with_hop_ids is on) the id plane: ids, features, and the masks
+        # hydration derives from the rows must move together, or pad
+        # slots in the un-permuted plane land under valid-mask positions
+        perms = tuple(
+            jax.random.permutation(pk, f.shape[0])
             for pk, f in zip(
                 jax.random.split(kperm, len(mb.feats)), mb.feats
             )
         )
-        return (mb, mb.replace(feats=perm_feats))
+        perm_feats = tuple(f[p] for f, p in zip(mb.feats, perms))
+        perm_ids = (
+            tuple(h[p] for h, p in zip(mb.hop_ids, perms))
+            if mb.hop_ids is not None
+            else None
+        )
+        return (mb, mb.replace(feats=perm_feats, hop_ids=perm_ids))
 
 
 class DeviceWholeGraphFlow(DeviceGraphTables):

@@ -702,6 +702,19 @@ def test_gae_and_dgi_flows(graph, tmp_path):
         np.array_equal(np.asarray(a), np.asarray(b))
         for a, b in zip(real.feats, fake.feats)
     ), "corruption must actually shuffle"
+    # with_hop_ids: the id plane must ride the SAME permutation as the
+    # rows, or pad slots land under valid-mask positions in the
+    # corrupted view (ids of the permuted rows == permuted ids)
+    iflow = DeviceDgiFlow(
+        graph, fanouts=[4], batch_size=16, with_hop_ids=True
+    )
+    ireal, ifake = jax.jit(iflow.sample)(jax.random.PRNGKey(2))
+    node_id = np.asarray(iflow.node_id)
+    for mb in (ireal, ifake):
+        for rows, ids in zip(mb.feats, mb.hop_ids):
+            np.testing.assert_array_equal(
+                np.asarray(ids), node_id[np.asarray(rows)]
+            )
     est2 = Estimator(
         DGI(dims=[16]), dflow,
         EstimatorConfig(model_dir=str(tmp_path / "dgi"),
@@ -825,7 +838,7 @@ def test_hop_ids_enable_id_embedding_models(graph, tmp_path):
     uest = Estimator(
         GraphSAGEUnsupervised(dims=[16], encoder_dim=8, max_id=300),
         uflow,
-        EstimatorConfig(model_dir="/tmp/etpu_unsup_ids", learning_rate=0.05,
+        EstimatorConfig(model_dir=str(tmp_path / "unsup_ids"), learning_rate=0.05,
                         log_steps=10**9, steps_per_call=2),
         feature_cache=DeviceFeatureCache(graph, ["feat"]),
     )
