@@ -62,6 +62,150 @@ def make_optimizer(cfg: EstimatorConfig) -> optax.GradientTransformation:
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
 
 
+def _optimizer_key(cfg: EstimatorConfig) -> tuple:
+    """EVERY cfg field make_optimizer reads, for the shared-jit cache key.
+    Add here whatever knob you add there — a missed field means two
+    Estimators differing only in that knob silently share one cached
+    update program."""
+    return (cfg.optimizer, cfg.learning_rate, cfg.momentum)
+
+
+
+# Jitted programs are shared ACROSS Estimator instances: tracing +
+# lowering an identical train step costs seconds per instance on a host
+# core even when the persistent compile cache spares the XLA compile
+# (re-instantiation patterns: determinism reruns, warm-started TransX
+# chains, hyperparameter sweeps). The cache dict is rooted ON the user's
+# flow (else feature-cache) object — not in a global — so the cached
+# closures never outlive the objects whose device buffers they pin: drop
+# the flow/cache and every program traced against it is freed with it.
+# Entries are keyed by everything else the traced program reads: the flax
+# model (structural repr — configs are ints/strings), the cfg fields
+# make_optimizer consumes, rng collections, the mesh, and the identity of
+# the non-root partner object (its id cannot be recycled while the entry
+# exists, because the closure holds it). Estimators with neither a device
+# flow nor a feature cache have no root to pin the lifetime to and simply
+# keep the pre-existing per-instance behavior. EULER_TPU_STEP_CACHE=0
+# disables all sharing.
+
+
+# per-root entry bound: each entry's closure can pin a partner object's
+# device buffers (e.g. a non-root DeviceFeatureCache's feature table), so
+# a sweep that misses every lookup (varying lr / fresh caches against one
+# shared flow) must not accumulate pins without bound — FIFO-evicting at
+# a small cap frees the evicted closure and everything only it pinned
+_JIT_CACHE_MAX = 8
+
+
+def _jit_cache(root) -> dict | None:
+    """The per-object jit-program cache rooted on `root`, or None when
+    sharing is off / there is no root."""
+    if root is None or os.environ.get("EULER_TPU_STEP_CACHE", "1") == "0":
+        return None
+    cache = getattr(root, "_etpu_jit_cache", None)
+    if cache is None:
+        cache = {}
+        try:
+            root._etpu_jit_cache = cache
+        except AttributeError:  # __slots__ or frozen object: no sharing
+            return None
+    return cache
+
+
+def _jit_cache_put(cache: dict, key, value):
+    # "probe" is exempt from eviction: it is the first insertion and the
+    # one entry every Estimator on the flow re-uses, so FIFO would recycle
+    # exactly the wrong entry in an all-miss sweep
+    evictable = [k for k in cache if k != "probe"]
+    while len(evictable) >= _JIT_CACHE_MAX:
+        cache.pop(evictable.pop(0))
+    cache[key] = value
+
+
+def _flow_probe(flow):
+    """Jitted flow.sample for the init-shape probe, memoized on the flow
+    (a fresh jax.jit wrapper would re-trace for every Estimator sharing
+    the flow)."""
+    cache = _jit_cache(flow)
+    if cache is None:
+        return jax.jit(flow.sample)
+    if "probe" not in cache:
+        _jit_cache_put(cache, "probe", jax.jit(flow.sample))
+    return cache["probe"]
+
+
+def _hydrate_batch(feature_cache, batch: tuple) -> tuple:
+    from euler_tpu.dataflow.base import MiniBatch, hydrate_blocks
+
+    batch = tuple(
+        hydrate_blocks(b) if isinstance(b, MiniBatch) else b for b in batch
+    )
+    return (
+        feature_cache.hydrate_args(batch)
+        if feature_cache is not None
+        else batch
+    )
+
+
+def _apply_update(model, tx, feature_cache, params, opt_state, step_rngs, batch):
+    """One traced optimizer step: hydrate → loss/grad → update."""
+    batch = _hydrate_batch(feature_cache, batch)
+
+    def loss_fn(p):
+        _, loss, _, metric = model.apply(p, *batch, rngs=step_rngs)
+        return loss, metric
+
+    (loss, metric), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss, metric
+
+
+def _step_args(device_flow, xs):
+    """Per-step scan/step input → model args. Host flows ship the batch
+    itself; device flows ship a PRNG key and sample on device. A flow
+    returning a tuple supplies multiple model args (e.g. the unsupervised
+    (src, pos, negs) triple)."""
+    if device_flow is not None:
+        out = device_flow.sample(xs[0])
+        return out if isinstance(out, tuple) else (out,)
+    return xs
+
+
+def _build_train_steps(model, tx, device_flow, feature_cache):
+    """The two jitted update programs, closing over ONLY the objects the
+    trace reads — shareable across Estimator instances via _jit_cache
+    without pinning any instance's params."""
+
+    # donate params+opt_state: without it the update keeps both old and
+    # new buffers alive across the step — 2x the HBM for model state
+    # (the big cost for sharded embedding tables)
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, rngs, *batch):
+        return _apply_update(
+            model, tx, feature_cache,
+            params, opt_state, rngs, _step_args(device_flow, batch),
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def multi_step(params, opt_state, rngs, *stacked_batch):
+        def body(carry, xs):
+            params, opt_state = carry
+            step_rngs, batch = xs
+            params, opt_state, loss, metric = _apply_update(
+                model, tx, feature_cache,
+                params, opt_state, step_rngs, _step_args(device_flow, batch),
+            )
+            return (params, opt_state), (loss, metric)
+
+        (params, opt_state), (losses, metrics) = jax.lax.scan(
+            body, (params, opt_state), (rngs, stacked_batch)
+        )
+        return params, opt_state, losses, metrics[-1]
+
+    return train_step, multi_step
+
+
 class Estimator:
     """Drives a (emb, loss, metric_name, metric) flax model.
 
@@ -134,17 +278,7 @@ class Estimator:
         return shard_batch(batch, self.mesh, batch_axis=1 if stacked else 0)
 
     def _hydrate(self, batch: tuple) -> tuple:
-        from euler_tpu.dataflow.base import MiniBatch, hydrate_blocks
-
-        batch = tuple(
-            hydrate_blocks(b) if isinstance(b, MiniBatch) else b
-            for b in batch
-        )
-        return (
-            self.feature_cache.hydrate_args(batch)
-            if self.feature_cache is not None
-            else batch
-        )
+        return _hydrate_batch(self.feature_cache, batch)
 
     def _ensure_init(self):
         if self.params is not None:
@@ -165,7 +299,7 @@ class Estimator:
             self.opt_state = self.tx.init(self.params)
             return
         if self._device_flow is not None:
-            out = jax.jit(self._device_flow.sample)(self._flow_keys(0, 1)[0])
+            out = _flow_probe(self._device_flow)(self._flow_keys(0, 1)[0])
             batch = out if isinstance(out, tuple) else (out,)
         else:
             batch = self._put(
@@ -210,67 +344,56 @@ class Estimator:
         k = jax.random.fold_in(self._base_key, step)
         return dict(zip(self._rng_names, jax.random.split(k, len(self._rng_names))))
 
-    def _apply_update(self, params, opt_state, step_rngs, batch):
-        """One traced optimizer step: hydrate → loss/grad → update."""
-        batch = self._hydrate(batch)
 
-        def loss_fn(p):
-            _, loss, _, metric = self.model.apply(p, *batch, rngs=step_rngs)
-            return loss, metric
 
-        (loss, metric), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params
+    def _model_key(self) -> tuple:
+        m = self.model
+        return (type(m).__module__, type(m).__qualname__, repr(m))
+
+    def _ensure_steps(self):
+        """Bind the jitted step pair, shared via the root object's jit
+        cache when possible (see _jit_cache above)."""
+        if self._jit_train is not None:
+            return
+        # root on the flow when there is one (the closure pins both flow
+        # and cache; the flow outliving the cache is the unusual case),
+        # else on the feature cache
+        root = (
+            self._device_flow
+            if self._device_flow is not None
+            else self.feature_cache
         )
-        updates, opt_state = self.tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss, metric
-
-    def _step_batch(self, xs):
-        """Per-step scan/step input → model args. Host flows ship the
-        batch itself; device flows ship a PRNG key and sample on device.
-        A flow returning a tuple supplies multiple model args (e.g. the
-        unsupervised (src, pos, negs) triple)."""
-        if self._device_flow is not None:
-            out = self._device_flow.sample(xs[0])
-            return out if isinstance(out, tuple) else (out,)
-        return xs
+        cache = _jit_cache(root)
+        key = None
+        if cache is not None:
+            key = (
+                "steps",
+                self._model_key(),
+                _optimizer_key(self.cfg),
+                self._rng_names,
+                id(self.feature_cache)
+                if self.feature_cache is not None and root is not self.feature_cache
+                else None,
+                self.mesh,
+            )
+            if key in cache:
+                self._jit_train, self._jit_train_scan = cache[key]
+                return
+        steps = _build_train_steps(
+            self.model, self.tx, self._device_flow, self.feature_cache
+        )
+        self._jit_train, self._jit_train_scan = steps
+        if cache is not None:
+            _jit_cache_put(cache, key, steps)
 
     def _train_step(self):
-        if self._jit_train is None:
-
-            # donate params+opt_state: without it the update keeps both
-            # old and new buffers alive across the step — 2x the HBM for
-            # model state (the big cost for sharded embedding tables)
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
-            def train_step(params, opt_state, rngs, *batch):
-                return self._apply_update(
-                    params, opt_state, rngs, self._step_batch(batch)
-                )
-
-            self._jit_train = train_step
+        self._ensure_steps()
         return self._jit_train
 
     def _train_step_scan(self):
         """K optimizer steps per dispatch via lax.scan over stacked batches
         (host flows) or per-step sampling keys (device flows)."""
-        if self._jit_train_scan is None:
-
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
-            def multi_step(params, opt_state, rngs, *stacked_batch):
-                def body(carry, xs):
-                    params, opt_state = carry
-                    step_rngs, batch = xs
-                    params, opt_state, loss, metric = self._apply_update(
-                        params, opt_state, step_rngs, self._step_batch(batch)
-                    )
-                    return (params, opt_state), (loss, metric)
-
-                (params, opt_state), (losses, metrics) = jax.lax.scan(
-                    body, (params, opt_state), (rngs, stacked_batch)
-                )
-                return params, opt_state, losses, metrics[-1]
-
-            self._jit_train_scan = multi_step
+        self._ensure_steps()
         return self._jit_train_scan
 
     def _rngs_stacked(self, step: int, k: int):
@@ -432,13 +555,29 @@ class Estimator:
             fetched.extend(np.asarray(jnp.concatenate(history)).tolist())
         return fetched[:steps]
 
+    def _shared_apply_jit(self, kind: str, build):
+        """Get-or-build an eval/embed program, rooted on the feature
+        cache (the only instance object those programs read besides the
+        model)."""
+        cache = _jit_cache(self.feature_cache)
+        if cache is None:
+            return build()
+        key = (kind, self._model_key(), self._rng_names)
+        if key not in cache:
+            _jit_cache_put(cache, key, build())
+        return cache[key]
+
     def evaluate(self, batches: Iterable[tuple]) -> dict:
         self._ensure_init()
         if self._jit_eval is None:
-            self._jit_eval = jax.jit(
-                lambda p, rngs, *b: self.model.apply(
-                    p, *self._hydrate(b), rngs=rngs
-                )[1:4:2]
+            model, fc = self.model, self.feature_cache
+            self._jit_eval = self._shared_apply_jit(
+                "eval",
+                lambda: jax.jit(
+                    lambda p, rngs, *b: model.apply(
+                        p, *_hydrate_batch(fc, b), rngs=rngs
+                    )[1:4:2]
+                ),
             )  # (loss, metric)
         name = None
         losses, metrics = [], []
@@ -462,10 +601,14 @@ class Estimator:
         """Embeds batches; writes embedding_{worker}.npy + ids_{worker}.npy."""
         self._ensure_init()
         if self._jit_embed is None:
-            self._jit_embed = jax.jit(
-                lambda p, b: self.model.apply(
-                    p, *self._hydrate((b,)), method=self.model.embed
-                )
+            model, fc = self.model, self.feature_cache
+            self._jit_embed = self._shared_apply_jit(
+                "embed",
+                lambda: jax.jit(
+                    lambda p, b: model.apply(
+                        p, *_hydrate_batch(fc, (b,)), method=model.embed
+                    )
+                ),
             )
         embs, all_ids = [], []
         for batch, chunk_ids in zip(batches, ids):

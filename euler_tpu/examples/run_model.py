@@ -91,6 +91,17 @@ def build_parser():
     return ap
 
 
+def _require_checkpoint(est):
+    """evaluate/infer score TRAINED parameters; without this guard a
+    missing checkpoint either crashes opaquely (params None on the
+    embedding-family fast path) or silently scores random init."""
+    if not est.restore():
+        raise SystemExit(
+            f"no checkpoint under {est.cfg.model_dir!r} — run --mode train "
+            "with the same --model-dir first"
+        )
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.platform:
@@ -404,8 +415,19 @@ def main(argv=None):
     if args.mode != "train" and flow is None:
         import jax.numpy as jnp
 
-        est.restore()
-        if name in KG_MODELS and args.mode == "evaluate":
+        # reject an unsupported mode BEFORE demanding a checkpoint: the
+        # "train first" advice would be a dead end for a mode this model
+        # can never run
+        kg_eval = name in KG_MODELS and args.mode == "evaluate"
+        emb_infer = (
+            name in ("deepwalk", "node2vec", "line") and args.mode == "infer"
+        )
+        if not (kg_eval or emb_infer):
+            raise SystemExit(
+                f"mode {args.mode!r} is not supported for model {name!r}"
+            )
+        _require_checkpoint(est)
+        if kg_eval:
             from euler_tpu.models import kg_rank_eval
 
             if ds is not None and hasattr(ds, "eval_triples") and not args.synthetic:
@@ -417,7 +439,7 @@ def main(argv=None):
                 ).astype(np.int32)
             print(kg_rank_eval(model, est.params, triples, num_entities=max_id))
             return 0
-        if name in ("deepwalk", "node2vec", "line") and args.mode == "infer":
+        if emb_infer:
             ids = np.concatenate(
                 [np.asarray(sh.node_ids) for sh in graph.shards]
             )
@@ -435,9 +457,6 @@ def main(argv=None):
             np.save(os.path.join(cfg.model_dir, "ids_0.npy"), ids)
             print(f"wrote {emb.shape} embeddings to {cfg.model_dir}")
             return 0
-        raise SystemExit(
-            f"mode {args.mode!r} is not supported for model {name!r}"
-        )
     if args.mode == "train":
         hist = est.train()
         if len(hist):
@@ -449,12 +468,12 @@ def main(argv=None):
         batches_fn = lambda: id_batches(flow, splits["val"], args.batch_size)[0]  # noqa: E731
         print(est.train_and_evaluate(batches_fn, eval_every=max(args.total_steps // 2, 1)))
     elif args.mode == "evaluate":
-        est.restore()
+        _require_checkpoint(est)
         splits = ds.splits(graph) if ds else {"test": graph.sample_node(64)}
         batches, _ = id_batches(flow, splits["test"], args.batch_size)
         print(est.evaluate(batches))
     elif args.mode == "infer":
-        est.restore()
+        _require_checkpoint(est)
         splits = ds.splits(graph) if ds else {"test": graph.sample_node(64)}
         ids = np.concatenate(list(splits.values()))
         batches, chunks = id_batches(flow, ids, args.batch_size)

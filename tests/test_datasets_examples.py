@@ -97,6 +97,17 @@ def test_kg_evaluate_mode(tmp_path):
         assert rc == 0 or rc is None
 
 
+def test_infer_without_checkpoint_is_a_clear_error(tmp_path):
+    """evaluate/infer on an untrained model_dir must say so instead of
+    crashing opaquely (params None) or scoring random init."""
+    with pytest.raises(SystemExit, match="no checkpoint"):
+        run_model([
+            "--model", "deepwalk", "--dataset", "cora", "--synthetic",
+            "--total-steps", "3", "--batch-size", "4", "--embedding-dim",
+            "8", "--model-dir", str(tmp_path), "--mode", "infer",
+        ])
+
+
 def test_deepwalk_infer_mode(tmp_path):
     for mode in ("train", "infer"):
         rc = run_model([

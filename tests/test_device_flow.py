@@ -29,6 +29,14 @@ def flow(graph):
     )
 
 
+@pytest.fixture(scope="module")
+def fcache(graph):
+    # shared across tests: estimators keyed on the same (model, flow,
+    # cache) objects reuse jitted train steps via the estimator's
+    # cross-instance step cache instead of re-tracing per test
+    return DeviceFeatureCache(graph, ["feat"])
+
+
 def test_structure_matches_host_lean_wire(graph, flow):
     """The device batch must be pytree-identical to a device_put host lean
     batch: models, hydrate_blocks, and the feature cache are shared."""
@@ -223,11 +231,11 @@ def test_weighted_root_distribution():
     assert abs(hot - 40 / 44) < 0.05, hot
 
 
-def test_estimator_trains_and_is_deterministic(graph, tmp_path):
+def test_estimator_trains_and_is_deterministic(graph, flow, fcache, tmp_path):
+    # module-scoped flow/cache across runs: fresh Estimators on shared
+    # objects exercise the cross-instance jitted-step cache (_STEP_CACHE)
+
     def run(steps_per_call):
-        flow = DeviceSageFlow(
-            graph, fanouts=[4, 3], batch_size=16, label_feature="label"
-        )
         est = Estimator(
             GraphSAGESupervised(dims=[16, 16], label_dim=2),
             flow,
@@ -237,7 +245,7 @@ def test_estimator_trains_and_is_deterministic(graph, tmp_path):
                 log_steps=10**9,
                 steps_per_call=steps_per_call,
             ),
-            feature_cache=DeviceFeatureCache(graph, ["feat"]),
+            feature_cache=fcache,
         )
         return est.train(total_steps=12, log=False, save=False)
 
@@ -252,14 +260,41 @@ def test_estimator_trains_and_is_deterministic(graph, tmp_path):
     np.testing.assert_allclose(np.array(a), np.array(c), rtol=1e-4)
 
 
-def test_mesh_data_parallel_loss_parity(graph, tmp_path):
+def test_determinism_across_fresh_instances(graph, monkeypatch, tmp_path):
+    """The cache-MISS path: freshly traced steps on fresh flow/cache
+    objects must reproduce the same losses (the shared-fixture test above
+    reuses one jitted program, which cannot catch a fresh-trace
+    divergence)."""
+    monkeypatch.setenv("EULER_TPU_STEP_CACHE", "0")
+
+    def run():
+        flow = DeviceSageFlow(
+            graph, fanouts=[4, 3], batch_size=16, label_feature="label"
+        )
+        est = Estimator(
+            GraphSAGESupervised(dims=[16, 16], label_dim=2),
+            flow,
+            EstimatorConfig(
+                model_dir=str(tmp_path / "fresh"), learning_rate=0.05,
+                log_steps=10**9, steps_per_call=4,
+            ),
+            feature_cache=DeviceFeatureCache(graph, ["feat"]),
+        )
+        return est.train(total_steps=8, log=False, save=False)
+
+    assert run() == run(), "fresh traces must reproduce the loss sequence"
+
+
+def test_mesh_data_parallel_loss_parity(graph, flow, fcache, tmp_path):
     """Device-flow training under an 8-device data mesh: sampled batches
     are sharding-constrained along the data axis, and the loss sequence
     is identical to the single-device run (same keys → same values)."""
     from euler_tpu.parallel import make_mesh
 
+    base_flow = flow
+
     def run(mesh):
-        flow = DeviceSageFlow(
+        flow = base_flow if mesh is None else DeviceSageFlow(
             graph, fanouts=[4, 3], batch_size=16, label_feature="label",
             mesh=mesh,
         )
@@ -271,7 +306,7 @@ def test_mesh_data_parallel_loss_parity(graph, tmp_path):
                 learning_rate=0.05, log_steps=10**9, steps_per_call=4,
             ),
             mesh=mesh,
-            feature_cache=DeviceFeatureCache(graph, ["feat"]),
+            feature_cache=fcache,
         )
         return est.train(total_steps=8, log=False, save=False)
 
@@ -488,7 +523,7 @@ def test_edge_flow_distribution_and_training(tmp_path):
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
 
 
-def test_unsup_flow_triples_and_training(graph, tmp_path):
+def test_unsup_flow_triples_and_training(graph, fcache, tmp_path):
     """DeviceUnsupSageFlow: pos is a true neighbor of src (or src itself
     when src is isolated), and the triple trains GraphSAGEUnsupervised."""
     from euler_tpu.dataflow import DeviceUnsupSageFlow
@@ -509,7 +544,7 @@ def test_unsup_flow_triples_and_training(graph, tmp_path):
         EstimatorConfig(model_dir=str(tmp_path / "unsup"),
                         learning_rate=0.05, log_steps=10**9,
                         steps_per_call=4),
-        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+        feature_cache=fcache,
     )
     losses = est.train(total_steps=16, log=False, save=False)
     assert np.isfinite(losses).all()
@@ -667,7 +702,7 @@ def test_layerwise_flow_exact_when_frontier_fits(graph, tmp_path):
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
 
 
-def test_gae_and_dgi_flows(graph, tmp_path):
+def test_gae_and_dgi_flows(graph, fcache, tmp_path):
     """DeviceGaeFlow: (src, dst, neg) triples where dst is a true
     neighbor of src; DeviceDgiFlow: corrupted view is a permutation of
     the real batch's feature rows. Both train their models."""
@@ -687,7 +722,7 @@ def test_gae_and_dgi_flows(graph, tmp_path):
         EstimatorConfig(model_dir=str(tmp_path / "gae"),
                         learning_rate=0.05, log_steps=10**9,
                         steps_per_call=4),
-        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+        feature_cache=fcache,
     )
     losses = est.train(total_steps=8, log=False, save=False)
     assert np.isfinite(losses).all()
@@ -720,7 +755,7 @@ def test_gae_and_dgi_flows(graph, tmp_path):
         EstimatorConfig(model_dir=str(tmp_path / "dgi"),
                         learning_rate=0.05, log_steps=10**9,
                         steps_per_call=4),
-        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+        feature_cache=fcache,
     )
     losses = est2.train(total_steps=8, log=False, save=False)
     assert np.isfinite(losses).all()
@@ -812,7 +847,7 @@ def test_partitioned_graph_staging(tmp_path):
     assert np.isfinite(losses).all()
 
 
-def test_hop_ids_enable_id_embedding_models(graph, tmp_path):
+def test_hop_ids_enable_id_embedding_models(graph, fcache, tmp_path):
     """with_hop_ids=True ships per-hop ids (free on device, unlike the
     host lean wire), and an id-embedding model (ShallowEncoder) trains."""
     from euler_tpu.dataflow.base import hydrate_blocks
@@ -840,7 +875,7 @@ def test_hop_ids_enable_id_embedding_models(graph, tmp_path):
         uflow,
         EstimatorConfig(model_dir=str(tmp_path / "unsup_ids"), learning_rate=0.05,
                         log_steps=10**9, steps_per_call=2),
-        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+        feature_cache=fcache,
     )
     ulosses = uest.train(total_steps=4, log=False, save=False)
     assert np.isfinite(ulosses).all()
@@ -856,18 +891,15 @@ def test_hop_ids_enable_id_embedding_models(graph, tmp_path):
         flow,
         EstimatorConfig(model_dir=str(tmp_path / "ids"), learning_rate=0.05,
                         log_steps=10**9, steps_per_call=4),
-        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+        feature_cache=fcache,
     )
     losses = est.train(total_steps=8, log=False, save=False)
     assert np.isfinite(losses).all()
 
 
-def test_remainder_steps(graph, tmp_path):
+def test_remainder_steps(graph, flow, fcache, tmp_path):
     """total_steps not a multiple of steps_per_call exercises the
     single-step remainder path with sliced flow keys."""
-    flow = DeviceSageFlow(
-        graph, fanouts=[4, 3], batch_size=16, label_feature="label"
-    )
     est = Estimator(
         GraphSAGESupervised(dims=[16, 16], label_dim=2),
         flow,
@@ -875,7 +907,7 @@ def test_remainder_steps(graph, tmp_path):
             model_dir=str(tmp_path / "rem"), learning_rate=0.05,
             log_steps=10**9, steps_per_call=4,
         ),
-        feature_cache=DeviceFeatureCache(graph, ["feat"]),
+        feature_cache=fcache,
     )
     losses = est.train(total_steps=10, log=False, save=False)
     assert len(losses) == 10 and np.isfinite(losses).all()
