@@ -23,23 +23,29 @@ if [ "${probe:-}" != "tpu" ] && [ "${probe:-}" != "axon" ]; then
   echo "# no chip — nothing measured" && exit 1
 fi
 
-echo "# 1/4 weighted-lean remote leg (remote-only)"
+echo "# 1/5 weighted-lean remote leg (remote-only)"
 EULER_BENCH_WEIGHTED=1 timeout 900 python bench.py --remote-only \
   | tee "$OUT/bench_weighted.json"
 
-echo "# 2/4 device-flow headline (2 runs)"
+echo "# 2/5 device-flow headline (2 runs)"
 for i in 1 2; do
   EULER_BENCH_REMOTE=0 timeout 600 python bench.py \
     | tee "$OUT/devflow_$i.json"
 done
 
-echo "# 3/4 host-path headline rerun (variance band for the 5.12M row)"
+echo "# 3/5 host-path headline rerun (variance band for the 5.12M row)"
 EULER_BENCH_REMOTE=0 EULER_BENCH_DEVICE_FLOW=0 timeout 600 python bench.py \
   | tee "$OUT/hostflow_rerun.json"
 
-echo "# 4/4 scan-depth sweep (device flow, k=32/64)"
-for k in 32 64; do
+echo "# 4/5 scan-depth sweep (device flow, k=32/64/128)"
+for k in 32 64 128; do
   EULER_BENCH_REMOTE=0 EULER_BENCH_STEPS_PER_CALL=$k \
     timeout 600 python bench.py | tee "$OUT/devflow_k$k.json"
+done
+
+echo "# 5/5 remote in-flight depth sweep (pipelined client overlap)"
+for d in 1 8; do
+  EULER_BENCH_INFLIGHT=$d timeout 900 python bench.py --remote-only \
+    | tee "$OUT/remote_inflight$d.json"
 done
 echo "# done → $OUT"
