@@ -322,3 +322,52 @@ def test_scan_training_with_mesh(cluster_graph, tmp_path):
     )
     h = est.train(total_steps=6, save=False)
     assert len(h) == 6 and np.isfinite(h).all()
+
+
+def test_jit_step_cache_keying(tmp_path, monkeypatch):
+    """Cross-instance jit sharing (estimator.py _jit_cache) must share
+    EXACTLY when the traced program is identical: same (model config,
+    optimizer cfg, flow, cache) shares; a differing learning rate or
+    model width must NOT (a false hit silently trains with the wrong
+    program)."""
+    monkeypatch.setenv("EULER_TPU_STEP_CACHE", "1")  # the knob under test
+    from euler_tpu.dataflow import DeviceSageFlow
+    from euler_tpu.datasets.synthetic import random_graph
+    from euler_tpu.estimator import DeviceFeatureCache
+    from euler_tpu.models import GraphSAGESupervised
+
+    g = random_graph(num_nodes=120, out_degree=5, feat_dim=4, seed=0)
+    flow = DeviceSageFlow(g, fanouts=[3], batch_size=8, label_feature="label")
+    fcache = DeviceFeatureCache(g, ["feat"])
+
+    def est(lr=0.05, dims=(8,)):
+        return Estimator(
+            GraphSAGESupervised(dims=list(dims), label_dim=2),
+            flow,
+            EstimatorConfig(model_dir=str(tmp_path / "c"), learning_rate=lr,
+                            log_steps=10**9, steps_per_call=2),
+            feature_cache=fcache,
+        )
+
+    a, b = est(), est()
+    assert a._train_step_scan() is b._train_step_scan(), (
+        "identical config on shared flow/cache must reuse the program"
+    )
+    assert est(lr=0.2)._train_step_scan() is not a._train_step_scan(), (
+        "learning rate is part of the traced optimizer — no sharing"
+    )
+    assert est(dims=(16,))._train_step_scan() is not a._train_step_scan(), (
+        "model config is part of the trace — no sharing"
+    )
+    # the shared program still trains both instances to the same losses
+    assert a.train(total_steps=4, log=False, save=False) == b.train(
+        total_steps=4, log=False, save=False
+    )
+    # eviction never recycles the flow's init-shape probe
+    from euler_tpu.estimator.estimator import _JIT_CACHE_MAX, _flow_probe
+
+    probe = _flow_probe(flow)
+    for i in range(_JIT_CACHE_MAX + 3):
+        est(lr=0.3 + i / 100)._train_step_scan()
+    assert _flow_probe(flow) is probe, "probe must survive FIFO eviction"
+    assert len(flow._etpu_jit_cache) <= _JIT_CACHE_MAX + 1
