@@ -274,7 +274,12 @@ def run(platform: str) -> tuple[float, dict]:
             int(x)
             for x in os.environ.get("EULER_BENCH_DIMS", "128,128").split(",")
         ]
-        batch_size, fanouts = 1024, [10, 10]
+        # batch 1024 is the round-comparable headline config;
+        # EULER_BENCH_BATCH raises it for max-throughput rows (the
+        # device-flow step is dispatch/gather-overhead dominated at 1024,
+        # so more rows per step lift edges/s until HBM or pad waste bites)
+        batch_size = int(os.environ.get("EULER_BENCH_BATCH", 1024))
+        fanouts = [10, 10]
         # EULER_BENCH_STEPS_PER_CALL: scan depth per dispatch — the lever
         # that amortizes the tunnel's per-dispatch round trip. Measured
         # sweep on chip (artifacts/tpu_extras_r5): device flow 30.0M@16 →
@@ -341,7 +346,8 @@ def run(platform: str) -> tuple[float, dict]:
     )
     extra = {"backend": platform + ("-fallback" if CPU_FALLBACK else ""),
              "native_engine": bool(native), "bf16": bool(bf16),
-             "steps_per_call": steps_per_call, "device_flow": device_flow}
+             "steps_per_call": steps_per_call, "device_flow": device_flow,
+             "batch_size": batch_size}
     return value, extra
 
 

@@ -43,6 +43,10 @@ for k in 32 64 128; do
     timeout 600 python bench.py | tee "$OUT/devflow_k$k.json"
 done
 
+echo "# 4b/5 max-throughput row (device flow, batch 4096)"
+EULER_BENCH_REMOTE=0 EULER_BENCH_BATCH=4096 timeout 600 python bench.py \
+  | tee "$OUT/devflow_b4096.json"
+
 echo "# 5/5 remote in-flight depth sweep (pipelined client overlap)"
 for d in 1 8; do
   EULER_BENCH_INFLIGHT=$d timeout 900 python bench.py --remote-only \
