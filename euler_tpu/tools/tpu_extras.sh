@@ -9,7 +9,9 @@
 #      band around the 5.12M host-sampling number from tpu_suite.sh; the
 #      pin keeps the comparison apples-to-apples after the default flip
 #   4. scan-depth sweep on the device-flow path (per-dispatch RTT
-#      amortization)
+#      amortization, k=32/64/128) + a batch-4096 max-throughput row (the
+#      batch-1024 headline config is dispatch/gather-overhead dominated)
+#   5. remote in-flight depth sweep (pipelined-client overlap, d=1/8)
 #
 #   bash euler_tpu/tools/tpu_extras.sh [outdir]
 set -u

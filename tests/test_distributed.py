@@ -170,25 +170,33 @@ def test_remote_dataflow_training(cluster, tmp_path):
     assert np.isfinite(hist).all()
 
 
-@pytest.fixture(scope="module")
-def unit_cluster(tmp_path_factory, fixture_graph_dict):
-    """2-shard cluster over a unit-weight copy of the fixture graph —
-    the lean wire requires uniform weights."""
+def _unit_cluster_setup(base_dir, fixture_graph_dict, native):
+    """2-shard registry cluster over a unit-weight copy of the fixture
+    graph (the lean wire requires uniform weights). Shared by the
+    numpy-store fixture and the native-engine test so both topologies
+    stay identical."""
     import copy
 
     g = copy.deepcopy(fixture_graph_dict)
     for e in g["edges"]:
         e["weight"] = 1.0
-    d = tmp_path_factory.mktemp("unit")
-    data = str(d / "data")
+    data = str(base_dir / "data")
     convert_json(g, data, num_partitions=2)
-    reg = str(d / "reg")
+    reg = str(base_dir / "reg")
     services = [
-        serve_shard(data, 0, registry_path=reg, native=False),
-        serve_shard(data, 1, registry_path=reg, native=False),
+        serve_shard(data, 0, registry_path=reg, native=native),
+        serve_shard(data, 1, registry_path=reg, native=native),
     ]
     local = Graph.load(data, native=False)
     remote = connect(registry_path=reg, num_shards=2)
+    return remote, local, services
+
+
+@pytest.fixture(scope="module")
+def unit_cluster(tmp_path_factory, fixture_graph_dict):
+    remote, local, services = _unit_cluster_setup(
+        tmp_path_factory.mktemp("unit"), fixture_graph_dict, native=False
+    )
     yield remote, local
     for s in services:
         s.stop()
@@ -1022,3 +1030,43 @@ def test_pipelined_training_end_to_end(unit_cluster, tmp_path):
     )
     hist = est.train(save=False)
     assert np.isfinite(hist).all()
+
+
+def test_native_engine_behind_service(tmp_path, fixture_graph_dict):
+    """The bench/deployment hot path — shard servers backed by the C++
+    engine — must answer the remote surface identically to numpy-local:
+    every other cluster fixture here runs native=False, so without this
+    the engine-behind-the-wire combination ships untested."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.graph.native import engine_available
+
+    if not engine_available():
+        pytest.skip("native toolchain unavailable")
+    remote, local, services = _unit_cluster_setup(
+        tmp_path, fixture_graph_dict, native=True
+    )
+    try:
+        ids = np.concatenate(
+            [np.asarray(s.node_ids) for s in local.shards]
+        )
+        np.testing.assert_array_equal(
+            remote.node_type(ids), local.node_type(ids)
+        )
+        np.testing.assert_allclose(
+            remote.get_dense_feature(ids, ["dense2"]),
+            local.get_dense_feature(ids, ["dense2"]),
+        )
+        r_nbr, _, _, r_mask, _ = remote.get_full_neighbor(ids)
+        l_nbr, _, _, l_mask, _ = local.get_full_neighbor(ids)
+        np.testing.assert_array_equal(r_mask, l_mask)
+        np.testing.assert_array_equal(r_nbr * r_mask, l_nbr * l_mask)
+        # the fused one-RPC training batch rides the engine end to end
+        flow = SageDataFlow(
+            remote, ["dense2"], fanouts=[2, 2], label_feature="dense3",
+            rng=np.random.default_rng(0), feature_mode="rows", lean=True,
+        )
+        batch = flow.minibatch(4)
+        assert all(np.isfinite(np.asarray(f)).all() for f in batch.feats)
+    finally:
+        for s in services:
+            s.stop()
