@@ -579,13 +579,16 @@ class Estimator:
                     )[1:4:2]
                 ),
             )  # (loss, metric)
-        name = None
+        name = getattr(self, "_metric_name", None)
         losses, metrics = [], []
         for batch in batches:
             batch = self._put(batch)
             loss, metric = self._jit_eval(self.params, self._rngs(0), *batch)
             if name is None:
-                name = self.model.apply(
+                # the metric NAME is a static python string the jitted
+                # program can't return; one eager forward fetches it, once
+                # per Estimator (not per evaluate call)
+                name = self._metric_name = self.model.apply(
                     self.params, *self._hydrate(batch), rngs=self._rngs(0)
                 )[2]
             losses.append(float(loss))
