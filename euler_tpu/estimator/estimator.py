@@ -666,21 +666,27 @@ class Estimator:
         # checkpoint's own metadata, so genuine restore errors propagate
         # instead of silently resetting optimizer slots
         has_opt = "opt_state" in set(ckpt.metadata(path).item_metadata.keys())
+
+        def _args(tpl):
+            # restore each leaf straight onto the live tree's sharding
+            # (orbax otherwise re-reads it from the sharding file, with a
+            # warning, and the arrays land unsharded on meshes)
+            return jax.tree_util.tree_map(
+                lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
+                if isinstance(x, jax.Array)
+                else ocp.RestoreArgs(),
+                tpl,
+            )
+
+        item = {"params": self.params, "step": 0}
         if has_opt:
-            restored = ckpt.restore(
-                path,
-                item={
-                    "params": self.params,
-                    "opt_state": self.opt_state,
-                    "step": 0,
-                },
-            )
-            self.opt_state = restored["opt_state"]
-        else:
-            restored = ckpt.restore(
-                path, item={"params": self.params, "step": 0}
-            )
-            self.opt_state = self.tx.init(restored["params"])
+            item["opt_state"] = self.opt_state
+        restored = ckpt.restore(path, item=item, restore_args=_args(item))
+        self.opt_state = (
+            restored["opt_state"]
+            if has_opt
+            else self.tx.init(restored["params"])
+        )
         self.params = restored["params"]
         self.step = int(restored["step"])
         return True
