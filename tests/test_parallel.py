@@ -180,3 +180,43 @@ def test_sharded_embedding_train_step_keeps_sharding():
     mu = opt2[0].mu
     assert mu.sharding == t.table.sharding, (mu.sharding, t.table.sharding)
     assert np.isfinite(np.asarray(table2)).all()
+
+
+def test_restore_preserves_mesh_sharding(tmp_path):
+    """Checkpoint save under a (4,2) mesh, restore into a FRESH Estimator
+    on the same mesh: the id-embedding table must come back model-axis
+    sharded (restore_args pin each leaf to the live tree's sharding —
+    without them orbax restores from the sharding file or unsharded)."""
+    mesh = make_mesh(8, model=2)
+    g = make_cluster_graph()
+    rng = np.random.default_rng(0)
+    flow = SageDataFlow(
+        g, ["feat"], fanouts=[2], label_feature="label", rng=rng
+    )
+    model = GraphSAGESupervised(
+        dims=[8], label_dim=2, encoder_dim=8, max_id=64
+    )
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "m"), total_steps=2,
+        learning_rate=0.05, log_steps=1000,
+    )
+    est = Estimator(model, node_batches(g, flow, 8, rng=rng), cfg, mesh=mesh)
+    est.train()  # saves at end
+
+    est2 = Estimator(
+        model, node_batches(g, flow, 8, rng=np.random.default_rng(1)),
+        cfg, mesh=mesh,
+    )
+    assert est2.restore()
+    assert est2.step == 2
+    flat = jax.tree_util.tree_flatten_with_path(est2.params)[0]
+    tables = [
+        leaf for path, leaf in flat
+        if any(getattr(p, "key", None) == "table" for p in path)
+    ]
+    assert tables and MODEL_AXIS in str(tables[0].sharding.spec)
+    # restored values equal the saved ones
+    a = jax.tree_util.tree_leaves(est.params)
+    b = jax.tree_util.tree_leaves(est2.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
