@@ -13,7 +13,6 @@ offline (splits, shapes, and training code paths are identical).
 
 from __future__ import annotations
 
-import json
 import os
 
 import numpy as np

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from euler_tpu.nn.encoders import Embedding
-from euler_tpu.nn.metrics import hit_at_k, mean_rank, mrr
+from euler_tpu.nn.metrics import mrr
 
 
 def _l2norm(x, axis=-1, eps=1e-12):

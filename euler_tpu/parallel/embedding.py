@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from euler_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from euler_tpu.parallel.mesh import MODEL_AXIS
 
 
 def table_sharding(mesh: Mesh) -> NamedSharding:
