@@ -1,0 +1,35 @@
+"""bench.py is the driver's round artifact: its contract is ONE final
+parseable JSON line with the headline metric. A regression here silently
+destroys the round's recorded measurement, so the smoke path is gated."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_smoke_emits_final_json_line():
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        EULER_BENCH_REMOTE="0",  # local leg only: the contract's last line
+    )
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    json_lines = [
+        ln for ln in r.stdout.splitlines() if ln.startswith("{")
+    ]
+    assert json_lines, r.stdout[-500:]
+    row = json.loads(json_lines[-1])
+    assert row["metric"] == "graphsage_sampled_edges_per_sec_per_chip"
+    assert row["value"] > 0
+    assert row["unit"] == "edges/s"
+    assert "vs_baseline" in row and "backend" in row
+    assert row["device_flow"] is True  # smoke covers the production default
