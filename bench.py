@@ -65,6 +65,28 @@ REMOTE_BUDGET_S = float(os.environ.get("EULER_BENCH_REMOTE_BUDGET", 420.0))
 # server processes spawned by the remote leg, killable from the watchdog
 _REMOTE_PROCS: list = []
 
+# backend-probe failure metadata (timeouts, rc/stderr tails): attached to
+# the emitted JSON so a CPU-fallback run is self-explaining from the
+# artifact alone, not only from interleaved stderr. Survives the CPU
+# re-exec via EULER_BENCH_PROBE_META.
+_PROBE_FAILURES: list = []
+
+
+def _probe_meta() -> dict | None:
+    env_meta = os.environ.get("EULER_BENCH_PROBE_META")
+    if env_meta:
+        try:
+            return json.loads(env_meta)
+        except ValueError:
+            return {"raw": env_meta[:300]}
+    if _PROBE_FAILURES:
+        return {
+            "attempts": PROBE_ATTEMPTS,
+            "timeout_s": PROBE_TIMEOUT_S,
+            "failures": list(_PROBE_FAILURES),
+        }
+    return None
+
 
 def emit(
     value: float,
@@ -122,12 +144,20 @@ def warm_backend() -> str:
                     if r.stderr.strip()
                     else "<no stderr>"
                 )
+                _PROBE_FAILURES.append(
+                    {"attempt": attempt + 1, "rc": r.returncode,
+                     "stderr_tail": tail, "elapsed_s": round(time.time() - t0, 1)}
+                )
                 print(
                     f"# backend probe attempt {attempt + 1}"
                     f" rc={r.returncode}: {tail}",
                     file=sys.stderr,
                 )
             except subprocess.TimeoutExpired:
+                _PROBE_FAILURES.append(
+                    {"attempt": attempt + 1, "timeout": True,
+                     "timeout_s": PROBE_TIMEOUT_S}
+                )
                 print(
                     f"# backend probe attempt {attempt + 1} timed out"
                     f" after {PROBE_TIMEOUT_S:.0f}s",
@@ -139,6 +169,13 @@ def warm_backend() -> str:
             # in-process config mutation after a failed/hung init
             print("# accelerator unavailable; re-exec on CPU", file=sys.stderr)
             env = dict(os.environ, JAX_PLATFORMS="cpu")
+            # carry the probe failure metadata into the fallback process
+            # so its JSON artifact explains WHY it ran on CPU
+            env["EULER_BENCH_PROBE_META"] = json.dumps({
+                "attempts": PROBE_ATTEMPTS,
+                "timeout_s": PROBE_TIMEOUT_S,
+                "failures": _PROBE_FAILURES,
+            })
             # also drop the axon pool hint so sitecustomize skips the tunnel
             # registration entirely in the fresh process
             env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -348,6 +385,9 @@ def run(platform: str) -> tuple[float, dict]:
              "native_engine": bool(native), "bf16": bool(bf16),
              "steps_per_call": steps_per_call, "device_flow": device_flow,
              "batch_size": batch_size}
+    probe = _probe_meta()
+    if probe:
+        extra["probe"] = probe
     return value, extra
 
 
@@ -534,6 +574,44 @@ def run_remote(platform: str) -> tuple[float, dict]:
                 "remote lean wire downgraded during the run — fix before"
                 " trusting the number"
             )
+
+        # ---- planner RPC-count lane: measure (not assert) the L×P → P
+        # reduction of the fused SPLIT→exec_plan→MERGE fanout vs the
+        # per-op per-hop path, on the same roots/config ----
+        from euler_tpu.query.plan import plan_mode
+
+        probe_batches = 4
+        probe_roots = remote.sample_node(
+            batch_size, rng=np.random.default_rng(11)
+        )
+
+        def _plan_probe(mode: str) -> tuple[float, float]:
+            prev = os.environ.get("EULER_TPU_FUSED_PLAN")
+            os.environ["EULER_TPU_FUSED_PLAN"] = mode
+            try:
+                before = sum(sh.rpc_count for sh in remote.shards)
+                t0 = time.perf_counter()
+                for k in range(probe_batches):
+                    remote.fanout_with_rows(
+                        probe_roots, None, fanouts,
+                        rng=np.random.default_rng(100 + k),
+                    )
+                dt = time.perf_counter() - t0
+                rpcs = sum(sh.rpc_count for sh in remote.shards) - before
+                return rpcs / probe_batches, dt / probe_batches
+            finally:
+                if prev is None:
+                    os.environ.pop("EULER_TPU_FUSED_PLAN", None)
+                else:
+                    os.environ["EULER_TPU_FUSED_PLAN"] = prev
+
+        fused_rpcs, fused_s = _plan_probe("1")
+        perop_rpcs, perop_s = _plan_probe("0")
+        note(
+            f"plan lane: fused {fused_rpcs:.1f} rpc/batch"
+            f" ({fused_s * 1e3:.0f}ms) vs per-op {perop_rpcs:.1f}"
+            f" ({perop_s * 1e3:.0f}ms)"
+        )
         extra = {
             "backend": platform,
             "shards": shards,
@@ -543,7 +621,15 @@ def run_remote(platform: str) -> tuple[float, dict]:
             "bf16": bool(bf16),
             "weighted_lean": bool(weighted),
             "inflight": inflight,
+            "remote_fused": plan_mode() == "fused",
+            "remote_rpcs_per_batch": round(fused_rpcs, 2),
+            "remote_rpcs_per_batch_per_op": round(perop_rpcs, 2),
+            "remote_plan_ms_fused": round(fused_s * 1e3, 1),
+            "remote_plan_ms_per_op": round(perop_s * 1e3, 1),
         }
+        probe = _probe_meta()
+        if probe:
+            extra["probe"] = probe
         return value, extra
     finally:
         for p in procs:

@@ -341,6 +341,13 @@ class FullNeighborDataFlow(DataFlow):
 
     def query(self, roots: np.ndarray) -> MiniBatch:
         roots = np.asarray(roots, dtype=np.uint64)
+        from euler_tpu.query.plan import is_remote_graph, plan_mode
+
+        if is_remote_graph(self.graph) and plan_mode() != "off":
+            # remote cluster: ship the WHOLE query (every hop's capped
+            # expansion + features + degrees + labels) as one sub-plan
+            # per owner shard instead of ~(3·hops+2)×P per-op rounds
+            return self._query_plan(roots)
         hop_ids = [roots]
         hop_masks = [roots != DEFAULT_ID]
         blocks = []
@@ -371,6 +378,72 @@ class FullNeighborDataFlow(DataFlow):
             blocks=tuple(blocks),
             root_idx=roots.astype(np.int64).astype(np.int32),
             labels=self.labels_of(roots),
+            hop_ids=tuple(
+                ids.astype(np.int64).astype(np.int32) for ids in hop_ids
+            ),
+        )
+
+    def _query_plan(self, roots: np.ndarray) -> MiniBatch:
+        """Planner-routed remote query: one exec_plan RPC per owner shard
+        covers every hop, feature fetch, degree fetch, and the labels."""
+        from euler_tpu.query.plan import (
+            full_neighbor_plan,
+            plan_mode,
+            run_plan,
+        )
+
+        rows_mode = self.feature_mode == "rows"
+        plan = full_neighbor_plan(
+            self.edge_types,
+            self.num_hops,
+            self.max_degree,
+            feature_names=self.feature_names if not rows_mode else None,
+            label=self.label_feature,
+            rows=rows_mode,
+            degrees=self.gcn_norm,
+        )
+        seed = int(self.rng.integers(0, 2**63 - 1))
+        res = run_plan(
+            self.graph, plan, roots, seed, fused=plan_mode() == "fused"
+        )
+        hop_ids = [roots]
+        hop_masks = [roots != DEFAULT_ID]
+        blocks = []
+        width = len(roots)
+        for h in range(self.num_hops):
+            nbr, w, _, mask = res[f"__nb{h + 1}"]
+            blocks.append(fanout_block(width, self.max_degree, w, mask))
+            hop_ids.append(nbr.reshape(-1))
+            hop_masks.append(mask.reshape(-1))
+            width *= self.max_degree
+        if self.gcn_norm:
+            degs = [
+                np.asarray(res[f"__deg{h}"], np.float32)
+                for h in range(self.num_hops + 1)
+            ]
+            blocks = [
+                b.replace(dst_deg=degs[h], src_deg=degs[h + 1])
+                for h, b in enumerate(blocks)
+            ]
+        if rows_mode:
+            hop_rows = res["__hops"][4]
+            feats = tuple(
+                np.where(r >= 0, r + 1, 0).astype(np.int32) for r in hop_rows
+            )
+        elif self.feature_names:
+            feats = tuple(
+                res[f"__f{h}"] for h in range(self.num_hops + 1)
+            )
+        else:
+            feats = tuple(
+                np.zeros((len(ids), 0), np.float32) for ids in hop_ids
+            )
+        return MiniBatch(
+            feats=feats,
+            masks=tuple(hop_masks),
+            blocks=tuple(blocks),
+            root_idx=roots.astype(np.int64).astype(np.int32),
+            labels=res.get("__labels") if self.label_feature else None,
             hop_ids=tuple(
                 ids.astype(np.int64).astype(np.int32) for ids in hop_ids
             ),

@@ -149,6 +149,10 @@ class RemoteShard:
         self._num_nodes: int | None = None
         self._unit_w: dict[tuple | None, bool] = {}
         self._pool = None  # lazy in-flight request executor
+        # logical RPCs issued through this shard handle (retries count
+        # once) — the client half of the planner's L×P → P measurement;
+        # GIL-racy increments are fine for telemetry
+        self.rpc_count = 0
 
     def _executor(self) -> _DaemonExecutor:
         """Bounded executor for overlapped requests — the async
@@ -207,6 +211,7 @@ class RemoteShard:
 
     def call(self, op: str, values: list) -> list:
         err: Exception | None = None
+        self.rpc_count += 1
         for _ in range(self.RETRIES):
             r = self._pick()
             try:

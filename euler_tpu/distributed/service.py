@@ -11,6 +11,7 @@ Start programmatically (`GraphService(...).start()`) or as a process:
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
 import queue
@@ -253,6 +254,11 @@ class GraphService:
         self._beat = None
         self._cluster_g = None
         self._cluster_lock = threading.Lock()
+        # per-op request counter (read in-process by tests, over the wire
+        # via the "stats" op, and by the bench's RPC-count lane). Counter
+        # updates race benignly across pool workers — it is telemetry,
+        # not an invariant.
+        self.op_counts: collections.Counter = collections.Counter()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -305,7 +311,7 @@ class GraphService:
 
     # -- dispatch --------------------------------------------------------
 
-    COORDINATOR_OPS = ("sample_fanout", "sage_minibatch")
+    COORDINATOR_OPS = ("sample_fanout", "sage_minibatch", "exec_plan")
 
     def is_coordinator(self, op: str) -> bool:
         """True for ops that fan out to peer shards (blocking leaf RPCs);
@@ -315,12 +321,30 @@ class GraphService:
 
     def dispatch(self, op: str, a: list) -> list:
         s = self.store
+        self.op_counts[op] += 1
         if op == "get_meta":
             return [json.dumps(self.meta.to_dict())]
         if op == "ping":
             return [self.shard]
+        if op == "stats":
+            return [json.dumps(
+                {"shard": self.shard, "op_counts": dict(self.op_counts)}
+            )]
         if op == "num_nodes":
             return [int(s.num_nodes)]
+        if op == "exec_plan":
+            # fused per-shard sub-plan (SPLIT → REMOTE → MERGE parity,
+            # optimizer.h:49-86): the whole compiled chain for this
+            # shard's root subset runs here, next to the data; off-shard
+            # hops scatter worker-to-worker through the cluster facade
+            from euler_tpu.query.plan import execute_plan, pack_results
+
+            return pack_results(execute_plan(
+                self._cluster(),
+                json.loads(a[0]),
+                np.asarray(a[1], np.uint64),
+                int(a[2]),
+            ))
         if op == "sample_fanout":
             res = self._cluster().fanout_with_rows(
                 a[0], a[1], a[2], _rng_from(a[3])

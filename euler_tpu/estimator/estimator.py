@@ -49,25 +49,48 @@ class EstimatorConfig:
     steps_per_call: int = 1
 
 
+# The ONE table both the optimizer factory and its cache key derive from:
+# per optimizer name, the EstimatorConfig fields the built transformation
+# reads. make_optimizer consumes fields only through this table, so a new
+# knob that is not declared here raises at construction instead of
+# silently sharing one cached update program between differing configs.
+_OPTIMIZER_CFG_FIELDS: dict[str, tuple[str, ...]] = {
+    "adam": ("learning_rate",),
+    "adagrad": ("learning_rate",),
+    "sgd": ("learning_rate",),
+    "momentum": ("learning_rate", "momentum"),
+}
+
+_OPTIMIZER_FACTORIES = {
+    "adam": lambda a: optax.adam(a["learning_rate"]),
+    "adagrad": lambda a: optax.adagrad(a["learning_rate"]),
+    "sgd": lambda a: optax.sgd(a["learning_rate"]),
+    "momentum": lambda a: optax.sgd(
+        a["learning_rate"], momentum=a["momentum"]
+    ),
+}
+
+
 def make_optimizer(cfg: EstimatorConfig) -> optax.GradientTransformation:
-    """Optimizer factory (tf_euler/python/utils/optimizers.py parity)."""
-    if cfg.optimizer == "adam":
-        return optax.adam(cfg.learning_rate)
-    if cfg.optimizer == "adagrad":
-        return optax.adagrad(cfg.learning_rate)
-    if cfg.optimizer == "sgd":
-        return optax.sgd(cfg.learning_rate)
-    if cfg.optimizer == "momentum":
-        return optax.sgd(cfg.learning_rate, momentum=cfg.momentum)
-    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    """Optimizer factory (tf_euler/python/utils/optimizers.py parity).
+    Reads cfg ONLY through _OPTIMIZER_CFG_FIELDS, which also drives
+    _optimizer_key — the factory and the jit-cache key cannot drift."""
+    if cfg.optimizer not in _OPTIMIZER_CFG_FIELDS:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    args = {
+        f: getattr(cfg, f) for f in _OPTIMIZER_CFG_FIELDS[cfg.optimizer]
+    }
+    return _OPTIMIZER_FACTORIES[cfg.optimizer](args)
 
 
 def _optimizer_key(cfg: EstimatorConfig) -> tuple:
-    """EVERY cfg field make_optimizer reads, for the shared-jit cache key.
-    Add here whatever knob you add there — a missed field means two
-    Estimators differing only in that knob silently share one cached
-    update program."""
-    return (cfg.optimizer, cfg.learning_rate, cfg.momentum)
+    """Shared-jit cache key: derived mechanically from the cfg fields
+    make_optimizer consumes for THIS optimizer, so a field the update
+    program never reads (e.g. momentum under adam) cannot force a
+    spurious retrace, and a consumed field can never be missed."""
+    return (cfg.optimizer,) + tuple(
+        getattr(cfg, f) for f in _OPTIMIZER_CFG_FIELDS[cfg.optimizer]
+    )
 
 
 
@@ -87,6 +110,66 @@ def _optimizer_key(cfg: EstimatorConfig) -> tuple:
 # flow nor a feature cache have no root to pin the lifetime to and simply
 # keep the pre-existing per-instance behavior. EULER_TPU_STEP_CACHE=0
 # disables all sharing.
+
+
+def _structural_key(v):
+    """Collision-safe, hashable digest of a model's configuration.
+
+    repr(model) alone is NOT safe as a cache key: numpy summarizes large
+    arrays ("[0. 0. ... 0.]"), so two models differing only in a big
+    constant field repr identically and would silently share one traced
+    program — a wrong-result bug, not a perf bug. This walks the
+    dataclass fields structurally instead: scalars/strings by value,
+    containers recursively, arrays by dtype/shape/content digest, nested
+    modules by their own fields. A field of a type this function does not
+    understand degrades to identity (`id`) — that model never SHARES a
+    cached program (costing a retrace), which is the correct default for
+    unknown state.
+    """
+    import hashlib
+
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, (list, tuple)):
+        return ("seq", tuple(_structural_key(x) for x in v))
+    if isinstance(v, dict):
+        return (
+            "map",
+            tuple(
+                (str(k), _structural_key(v[k]))
+                for k in sorted(v, key=str)
+            ),
+        )
+    if isinstance(v, type):
+        return ("type", v.__module__, v.__qualname__)
+    if isinstance(v, np.dtype):
+        return ("dtype", str(v))
+    if hasattr(v, "shape") and hasattr(v, "dtype"):  # numpy / jax array
+        arr = np.asarray(v)
+        return (
+            "array", str(arr.dtype), tuple(arr.shape),
+            hashlib.sha1(np.ascontiguousarray(arr).tobytes()).hexdigest(),
+        )
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        # nested flax submodule / config dataclass; parent would recurse
+        # back up the module tree and name is identity-free metadata
+        return (
+            "dc", type(v).__module__, type(v).__qualname__,
+            tuple(
+                (f.name, _structural_key(getattr(v, f.name)))
+                for f in dataclasses.fields(v)
+                if f.name not in ("parent", "name")
+            ),
+        )
+    if callable(v) and hasattr(v, "__qualname__"):
+        # module-level functions (activations etc.) key by location;
+        # closures/lambdas share a qualname but can differ in behavior,
+        # so they fall through to identity below
+        if "<locals>" not in v.__qualname__ and "<lambda>" not in (
+            v.__qualname__
+        ):
+            return ("fn", getattr(v, "__module__", ""), v.__qualname__)
+    return ("id", id(v))
 
 
 # per-root entry bound: each entry's closure can pin a partner object's
@@ -348,7 +431,7 @@ class Estimator:
 
     def _model_key(self) -> tuple:
         m = self.model
-        return (type(m).__module__, type(m).__qualname__, repr(m))
+        return (type(m).__module__, type(m).__qualname__, _structural_key(m))
 
     def _ensure_steps(self):
         """Bind the jitted step pair, shared via the root object's jit

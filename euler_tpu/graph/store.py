@@ -1298,7 +1298,33 @@ class Graph:
         if self.num_shards == 1 and hasattr(self.shards[0], "fanout_with_rows"):
             return self.shards[0].fanout_with_rows(ids, edge_types, counts, rng)
         if all(hasattr(s, "call") for s in self.shards):
-            # remote cluster: forward the whole query to one shard server
+            # remote cluster: the planner SPLITs roots by owner and issues
+            # ONE exec_plan RPC per shard — each server runs every hop
+            # next to the data, so the batch costs P parallel coordinator
+            # RPCs instead of one serialized coordinator or L×P per-op
+            # rounds (optimizer.h:49-86 parity). EULER_TPU_FUSED_PLAN=0
+            # drives the same sub-plans per-op from here (seed-compatible
+            # A/B); "off" keeps the legacy single-coordinator RPC.
+            from euler_tpu.query.plan import fanout_plan, plan_mode, run_plan
+
+            mode = plan_mode()
+            if mode != "off":
+                seed = int(rng.integers(0, 2**63 - 1))
+                try:
+                    res = run_plan(
+                        self, fanout_plan(edge_types, counts),
+                        np.asarray(ids, np.uint64), seed,
+                        fused=mode == "fused",
+                    )
+                    return res["__hops"]
+                except RuntimeError as e:
+                    # capability gap only (old server missing both
+                    # exec_plan and the per-op lookup surface): drop to
+                    # the legacy coordinator RPC below
+                    msg = str(e)
+                    if "unknown op" not in msg and "num_nodes" not in msg:
+                        raise
+            # legacy: forward the whole query to one shard server
             # (spread coordinator load across shards)
             pick = int(rng.integers(self.num_shards))
             try:

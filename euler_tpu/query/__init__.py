@@ -1,1 +1,9 @@
 from euler_tpu.query.gql import Query, register_udf, run_gql, unregister_udf  # noqa: F401
+from euler_tpu.query.plan import (  # noqa: F401
+    execute_plan,
+    fanout_plan,
+    full_neighbor_plan,
+    plan_from_steps,
+    plan_mode,
+    run_plan,
+)

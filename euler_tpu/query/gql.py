@@ -30,6 +30,7 @@ map to (ids, weights, types, mask); values aliases to feature arrays.
 from __future__ import annotations
 
 import functools
+import os
 import re
 import threading
 
@@ -319,10 +320,34 @@ class Query:
         steps, plans = _compile_cached(gql)
         self.steps = list(steps)
         self._plans = plans
+        # serializable per-shard sub-plan (SPLIT → exec_plan → MERGE),
+        # or None when a step is not shard-fusable — then every graph
+        # takes the per-op loop below
+        from euler_tpu.query.plan import plan_from_steps
+
+        self._remote_plan = plan_from_steps(self.steps, self._plans)
 
     def run(self, graph, inputs: dict | None = None, rng=None) -> dict:
         inputs = inputs or {}
         rng = rng if rng is not None else _default_rng()
+        if self._remote_plan is not None and (
+            os.environ.get("EULER_TPU_FUSED_PLAN", "1") != "off"
+        ):
+            from euler_tpu.query.plan import is_remote_graph, run_plan
+
+            if is_remote_graph(graph):
+                # remote cluster: one fused exec_plan RPC per owner shard
+                # (or the seed-compatible per-op mode when
+                # EULER_TPU_FUSED_PLAN=0) instead of one round per step
+                plan, root_arg = self._remote_plan
+                if isinstance(root_arg, str):
+                    roots = np.asarray(inputs[root_arg], dtype=np.uint64)
+                elif isinstance(root_arg, list):
+                    roots = np.asarray(root_arg, dtype=np.uint64)
+                else:
+                    roots = np.asarray([root_arg], dtype=np.uint64)
+                seed = int(rng.integers(0, 2**63 - 1))
+                return run_plan(graph, plan, roots, seed)
         cur: np.ndarray | None = None  # current node frontier (u64)
         cur_edges: np.ndarray | None = None  # [n,3] edge frontier after e/outE
         last: object = None  # last step's full result

@@ -1,0 +1,655 @@
+"""Distributed query planner: fused per-shard remote sub-plans.
+
+The reference compiles a GQL traversal into SPLIT → per-shard REMOTE
+(fused sub-plan) → MERGE, so an L-step query on a P-shard cluster costs
+~P client RPCs (euler/parser/optimizer.h:49-86, remote_op.cc:31-120).
+This module is that optimizer for the TPU build: a compiled GQL chain
+(or a dataflow's fanout request) becomes a serializable PLAN — a list of
+op descriptors with arg bindings — and the client
+
+  1. SPLITs the root frontier by owner shard (``id % P``),
+  2. issues ONE pipelined ``exec_plan`` RPC per non-empty shard (the
+     server runs the whole sub-plan next to the data, scattering
+     intermediate hops worker-to-worker through its cluster facade), and
+  3. MERGEs the per-shard results back into root order, padded exactly
+     like the per-op scatter-gather path.
+
+Determinism contract: every random draw is keyed by an explicit integer
+seed derived from ``(base_seed, subset, step, shard)``, never by shared
+Generator stream position. A local store receives
+``default_rng(seed)``; a remote shard receives the raw seed (the server
+builds the identical ``default_rng(seed)``). Because the per-op
+fallback executes the SAME per-subset plan with the SAME derived seeds,
+fused and per-op runs are bit-identical for a fixed seed — the A/B
+parity the planner tests pin down.
+
+``EULER_TPU_FUSED_PLAN`` selects the execution mode:
+  "1" (default) — fused: one exec_plan RPC per shard;
+  "0"           — per-op: the client drives each step itself (the
+                  legacy ~L×P-round-trip path, kept for A/B parity);
+  "off"         — bypass the planner entirely (pre-planner routing);
+a server predating the ``exec_plan`` verb degrades to per-op for that
+subset automatically (same seeds → same results).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from euler_tpu.graph.store import DEFAULT_ID
+
+# GQL steps the planner can ship to a shard. Anything outside this set
+# (global sources like sampleN/sampleE, batch-global steps like limit /
+# sampleLNB, edge frontiers) keeps the legacy per-op execution.
+_TERMINAL_AFTER_DYNAMIC = ("as", "order_by")
+
+
+def plan_mode() -> str:
+    """EULER_TPU_FUSED_PLAN: "1" → fused (default), "0" → per-op A/B
+    fallback, "off" → skip the planner entirely (legacy routing)."""
+    v = os.environ.get("EULER_TPU_FUSED_PLAN", "1")
+    if v == "0":
+        return "per-op"
+    if v == "off":
+        return "off"
+    return "fused"
+
+
+def _fused_enabled() -> bool:
+    return plan_mode() == "fused"
+
+
+def step_seed(base: int, step: int, part: int) -> int:
+    """Deterministic per-(step, shard) sampling seed. Both execution
+    modes (fused server-side, per-op client-side) derive draws from this
+    — stream position never leaks between shards or steps."""
+    ss = np.random.SeedSequence([int(base) & (2**63 - 1), int(step), int(part)])
+    # 63-bit: seeds ride the wire as signed i64
+    return int(ss.generate_state(1, np.uint64)[0]) & (2**63 - 1)
+
+
+def subset_seed(base: int, part: int) -> int:
+    """Base seed of one owner-subset's sub-plan execution."""
+    ss = np.random.SeedSequence([int(base) & (2**63 - 1), int(part)])
+    return int(ss.generate_state(1, np.uint64)[0]) & (2**63 - 1)
+
+
+class _FixedSeed:
+    """rng stand-in whose only draw IS the seed: RemoteShard methods call
+    ``rng.integers(...)`` to pick the seed they put on the wire, so
+    handing them this object makes the server build ``default_rng(seed)``
+    — exactly what a local store receives. That equivalence is what makes
+    fused (server executes) and per-op (client executes) bit-identical."""
+
+    __slots__ = ("seed",)
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+
+    def integers(self, *args, **kwargs):
+        return self.seed
+
+
+def _rng_for(shard, seed: int):
+    if hasattr(shard, "call"):  # remote: ship the seed itself
+        return _FixedSeed(seed)
+    return np.random.default_rng(seed)
+
+
+# ---------------------------------------------------------------------------
+# plan construction
+# ---------------------------------------------------------------------------
+
+
+def _conds_json(conds) -> list | None:
+    """DNF conditions → plain JSON-able lists (numpy scalars unwrapped)."""
+    if not conds:
+        return None
+    clean = lambda v: v.item() if hasattr(v, "item") else v
+    return [
+        [[f, o, [clean(x) for x in v] if isinstance(v, list) else clean(v)]
+         for f, o, v in clause]
+        for clause in conds
+    ]
+
+
+def plan_from_steps(steps, plans):
+    """Compiled GQL steps → (plan, root_arg) or None when the chain is
+    not shard-fusable. ``root_arg`` is the v() argument (param name or
+    literal list); the ids themselves ride the exec_plan request as an
+    array, never inside the plan."""
+    if not steps or steps[0][0] != "v":
+        return None
+    plan = [{"op": "v", "conds": _conds_json(steps[0][2])}]
+    root_arg = steps[0][1][0]
+    dynamic = False  # a cap-less full_nb makes widths subset-dependent:
+    # only row-wise tuple ops may follow (the merged tuple is re-padded)
+    last_is_nb = False  # order_by is defined on a neighbor-step result
+    for i, ((fn, args, conds), pre) in enumerate(zip(steps, plans)):
+        if i == 0:
+            continue
+        if dynamic and fn not in _TERMINAL_AFTER_DYNAMIC:
+            return None
+        if fn == "sampleNB":
+            *types, n = args
+            plan.append({
+                "op": "sample_nb",
+                "et": [int(t) for t in types] if types else None,
+                "n": int(n),
+                "conds": _conds_json(conds),
+            })
+            last_is_nb = True
+        elif fn in ("outV", "inV"):
+            plan.append({
+                "op": "full_nb",
+                "et": [int(t) for t in args] if args else None,
+                "in_edges": fn == "inV",
+                "cap": None,
+                "conds": _conds_json(conds),
+            })
+            dynamic = True
+            last_is_nb = True
+        elif fn == "values":
+            names, udf_pairs = pre
+            if not names:
+                return None
+            plan.append({
+                "op": "values",
+                "names": list(names),
+                "udfs": [[int(k), u] for k, u in udf_pairs],
+            })
+            last_is_nb = False
+        elif fn == "label":
+            plan.append({"op": "label"})
+            last_is_nb = False
+        elif fn == "get":
+            plan.append({"op": "get"})
+            last_is_nb = False
+        elif fn == "has_type":
+            plan.append({"op": "has_type", "t": int(args[0])})
+            last_is_nb = False
+        elif fn == "order_by":
+            if not last_is_nb:
+                return None  # legacy raises "follows a neighbor step"
+            plan.append({
+                "op": "order_by",
+                "key": str(args[0]),
+                "desc": len(args) > 1 and str(args[1]).lower() == "desc",
+            })
+        elif fn == "as":
+            plan.append({"op": "as", "name": str(args[0])})
+        else:
+            # e/sampleE/sampleN*/sampleLNB/outE/limit: batch-global or
+            # edge-frontier semantics — per-op execution stays correct
+            return None
+    return plan, root_arg
+
+
+def fanout_plan(edge_types, counts, label: str | None = None) -> list:
+    """The dataflow fanout as a plan: L sampleNB hops + the global
+    feature-cache rows of every hop (+ optional root labels)."""
+    plan = [{"op": "v", "conds": None}]
+    if label:
+        plan.append({"op": "values", "names": [label], "udfs": [],
+                     "as": "__labels"})
+    et = None if edge_types is None else [int(t) for t in edge_types]
+    for c in counts:
+        plan.append({"op": "sample_nb", "et": et, "n": int(c), "conds": None})
+    plan.append({"op": "rows"})
+    return plan
+
+
+def full_neighbor_plan(
+    edge_types,
+    num_hops: int,
+    max_degree: int,
+    feature_names=None,
+    label: str | None = None,
+    rows: bool = False,
+    degrees: bool = False,
+) -> list:
+    """FullNeighborDataFlow's whole query as one plan: per hop a capped
+    full-neighbor expansion (+ features / true degrees), fetched next to
+    the data instead of one RPC round per hop per kind."""
+    et = None if edge_types is None else [int(t) for t in edge_types]
+    plan = [{"op": "v", "conds": None}]
+
+    def tap(h):
+        if feature_names:
+            plan.append({"op": "values", "names": list(feature_names),
+                         "udfs": [], "as": f"__f{h}"})
+        if degrees:
+            plan.append({"op": "degree", "et": et, "as": f"__deg{h}"})
+
+    if label:
+        plan.append({"op": "values", "names": [label], "udfs": [],
+                     "as": "__labels"})
+    tap(0)
+    for h in range(num_hops):
+        plan.append({"op": "full_nb", "et": et, "in_edges": False,
+                     "cap": int(max_degree), "conds": None,
+                     "as": f"__nb{h + 1}"})
+        tap(h + 1)
+    if rows:
+        plan.append({"op": "rows"})
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# execution (runs on the server for fused mode, on the client for per-op)
+# ---------------------------------------------------------------------------
+
+
+def _resolve_dnf(graph, conds):
+    out = []
+    for clause in conds:
+        c = []
+        for field, op, value in clause:
+            if field == "type" and isinstance(value, str):
+                value = graph.meta.node_type_id(value)
+            c.append((field, op, value))
+        out.append(c)
+    return out
+
+
+def _offsets(widths):
+    offs = [0]
+    for w in widths:
+        offs.append(offs[-1] + int(w))
+    return offs
+
+
+def _fetch_values(graph, cur, names, udf_pairs):
+    """values() against a node frontier: one batched fetch, UDF pushdown
+    to the owning shard when available (same fallback contract as the
+    legacy executor in query/gql.py)."""
+    from euler_tpu.query.gql import apply_udf
+
+    if not udf_pairs:
+        return graph.get_dense_feature(cur, list(names))
+    udf_idx = [k for k, _ in udf_pairs]
+    udf_names = {k: u for k, u in udf_pairs}
+    pushdown = getattr(graph, "get_dense_feature_udf", None)
+    udf_cols = None
+    if pushdown is not None:
+        try:
+            agg, agg_w = pushdown(
+                cur,
+                [names[k] for k in udf_idx],
+                [udf_names[k] for k in udf_idx],
+            )
+        except (RuntimeError, ValueError) as e:
+            s = str(e)
+            if "unknown op" not in s and "unknown UDF" not in s:
+                raise
+            agg = None
+        if agg is not None:
+            ao = _offsets(agg_w)
+            udf_cols = [agg[:, ao[i]: ao[i + 1]] for i in range(len(udf_idx))]
+    fetch_idx = [
+        k for k in range(len(names))
+        if udf_cols is None or k not in udf_idx
+    ]
+    flat = None
+    offs = None
+    if fetch_idx:
+        fetch_names = [names[k] for k in fetch_idx]
+        widths = [
+            graph.meta.feature_spec(nm, node=True).dim for nm in fetch_names
+        ]
+        flat = graph.get_dense_feature(cur, fetch_names)
+        offs = _offsets(widths)
+    cols = []
+    fpos = 0
+    upos = 0
+    for k in range(len(names)):
+        if udf_cols is not None and k in udf_idx:
+            cols.append(udf_cols[upos])
+            upos += 1
+            continue
+        block = flat[:, offs[fpos]: offs[fpos + 1]]
+        fpos += 1
+        if k in udf_names:
+            block = apply_udf(udf_names[k], block)
+        cols.append(block)
+    return np.concatenate(cols, axis=1)
+
+
+def _apply_nb_conds(graph, conds, nbr, w, tt, mask):
+    keep = graph.condition_mask(
+        nbr.reshape(-1), _resolve_dnf(graph, conds)
+    ).reshape(nbr.shape)
+    keep &= mask
+    return (
+        np.where(keep, nbr, DEFAULT_ID),
+        np.where(keep, w, 0.0).astype(np.float32),
+        np.where(keep, tt, -1),
+        keep,
+    )
+
+
+def execute_plan(graph, plan, roots, base_seed: int) -> dict:
+    """Run a sub-plan against a Graph facade. Returns {alias: tagged},
+    plus "_" (the last step's result) and, when the plan contains a
+    ``rows`` op, "__hops". Tags — ("arr", mult, array),
+    ("nb", mult, (nbr, w, tt, mask)), ("hops", mults, five per-hop
+    lists) — carry the per-root row multiplicity the client merge needs
+    to interleave subsets back into root order."""
+    roots = np.asarray(roots, dtype=np.uint64)
+    track_hops = any(step["op"] == "rows" for step in plan)
+    cur = roots
+    m = 1  # frontier rows per root
+    last = None
+    results: dict[str, tuple] = {}
+    hop_ids = [cur]
+    hop_w = [np.ones(len(cur), np.float32)]
+    hop_tt: list = [None]  # hop-0 types cost a scatter; resolved by "rows"
+    hop_mask = [cur != DEFAULT_ID]
+    hop_mults = [1]
+
+    for t, step in enumerate(plan):
+        op = step["op"]
+        if op == "v":
+            if step.get("conds"):
+                keep = graph.condition_mask(
+                    cur, _resolve_dnf(graph, step["conds"])
+                )
+                cur = np.where(keep, cur, DEFAULT_ID)
+                hop_ids[0] = cur
+                hop_mask[0] = cur != DEFAULT_ID
+            last = ("arr", m, cur)
+        elif op == "sample_nb":
+            et, n = step["et"], int(step["n"])
+
+            def fn(sh, sub, et=et, n=n, t=t):
+                return sh.sample_neighbor(
+                    sub, et, n, _rng_for(sh, step_seed(base_seed, t, sh.part))
+                )
+
+            nbr, w, tt, mask, _ = graph._scatter_gather(cur, fn)
+            mask = np.asarray(mask, dtype=bool)
+            if step.get("conds"):
+                nbr, w, tt, mask = _apply_nb_conds(
+                    graph, step["conds"], nbr, w, tt, mask
+                )
+            last = ("nb", m, (nbr, w, tt, mask))
+            cur = nbr.reshape(-1)
+            m *= n
+            if track_hops:
+                hop_ids.append(cur)
+                hop_w.append(w.reshape(-1).astype(np.float32))
+                hop_tt.append(tt.reshape(-1).astype(np.int32))
+                hop_mask.append(mask.reshape(-1))
+                hop_mults.append(m)
+        elif op == "full_nb":
+            nbr, w, tt, mask, _ = graph.get_full_neighbor(
+                cur, step["et"], max_degree=step["cap"],
+                in_edges=bool(step.get("in_edges")),
+            )
+            mask = np.asarray(mask, dtype=bool)
+            if step.get("conds"):
+                nbr, w, tt, mask = _apply_nb_conds(
+                    graph, step["conds"], nbr, w, tt, mask
+                )
+            last = ("nb", m, (nbr, w, tt, mask))
+            cur = nbr.reshape(-1)
+            if step["cap"] is not None:
+                m *= int(step["cap"])
+                if track_hops:
+                    hop_ids.append(cur)
+                    hop_w.append(w.reshape(-1).astype(np.float32))
+                    hop_tt.append(tt.reshape(-1).astype(np.int32))
+                    hop_mask.append(mask.reshape(-1))
+                    hop_mults.append(m)
+        elif op == "values":
+            last = (
+                "arr", m,
+                _fetch_values(graph, cur, step["names"], step["udfs"]),
+            )
+        elif op == "label":
+            last = ("arr", m, np.asarray(graph.node_type(cur)))
+        elif op == "get":
+            last = ("arr", m, cur)
+        elif op == "has_type":
+            keep = np.asarray(graph.node_type(cur)) == int(step["t"])
+            cur = np.where(keep, cur, DEFAULT_ID)
+            last = ("arr", m, cur)
+        elif op == "degree":
+            last = (
+                "arr", m,
+                np.asarray(graph.degree_sum(cur, step.get("et")), np.int64),
+            )
+        elif op == "order_by":
+            kind, mm, (nbr, w, tt, mask) = last
+            key = w if step["key"] == "weight" else nbr
+            order = np.argsort(
+                -key if step["desc"] else key, axis=1, kind="stable"
+            )
+            take = np.take_along_axis
+            last = (kind, mm, (
+                take(nbr, order, 1), take(w, order, 1),
+                take(tt, order, 1), take(mask, order, 1),
+            ))
+            cur = last[2][0].reshape(-1)
+        elif op == "as":
+            pass  # capture handled below
+        elif op == "rows":
+            all_rows = np.asarray(
+                graph.lookup_rows(np.concatenate(hop_ids)), np.int64
+            )
+            offs = _offsets([len(h) for h in hop_ids])
+            hop_rows = [
+                all_rows[offs[i]: offs[i + 1]] for i in range(len(hop_ids))
+            ]
+            hop_tt[0] = np.asarray(graph.node_type(hop_ids[0]), np.int32)
+            results["__hops"] = ("hops", list(hop_mults), (
+                hop_ids, hop_w, list(hop_tt), hop_mask, hop_rows,
+            ))
+        else:
+            raise ValueError(f"unknown plan op {op!r}")
+        if step.get("as"):
+            results[str(step["as"])] = last
+        if op == "as":
+            results[str(step["name"])] = last
+    results["_"] = last
+    return results
+
+
+# ---------------------------------------------------------------------------
+# wire packing (exec_plan response)
+# ---------------------------------------------------------------------------
+
+
+def pack_results(results: dict) -> list:
+    """Tagged results dict → flat wire values: [manifest_json, payload...].
+    Bool arrays survive as uint8 on the wire; unpack restores them by
+    position convention (nb[3] and hops mask list)."""
+    manifest = []
+    payload: list = []
+    for name, (kind, mult, value) in results.items():
+        if kind == "arr":
+            manifest.append([name, kind, mult, 1])
+            payload.append(value)
+        elif kind == "nb":
+            manifest.append([name, kind, mult, 4])
+            payload.extend(value)
+        elif kind == "hops":
+            manifest.append([name, kind, mult, 5])
+            payload.extend(list(v) for v in value)  # 5 lists of arrays
+        else:
+            raise ValueError(f"cannot pack result kind {kind!r}")
+    return [json.dumps(manifest)] + payload
+
+
+def unpack_results(values: list) -> dict:
+    manifest = json.loads(values[0])
+    out = {}
+    pos = 1
+    for name, kind, mult, n in manifest:
+        if kind == "arr":
+            out[name] = (kind, mult, values[pos])
+        elif kind == "nb":
+            nbr, w, tt, mask = values[pos: pos + 4]
+            out[name] = (kind, mult, (nbr, w, tt, np.asarray(mask, bool)))
+        else:  # hops
+            ids, w, tt, mask, rows = values[pos: pos + 5]
+            out[name] = (kind, mult, (
+                list(ids), list(w), list(tt),
+                [np.asarray(mk, bool) for mk in mask],
+                [np.asarray(r, np.int64) for r in rows],
+            ))
+        pos += n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# client entry: SPLIT → exec_plan per shard (or per-op) → MERGE
+# ---------------------------------------------------------------------------
+
+
+def _fill_like(a: np.ndarray, n_rows: int) -> np.ndarray:
+    """Output template with the same fill convention as _scatter_gather:
+    DEFAULT_ID for u64 ids, -1 for int types/rows, zeros else."""
+    out = np.zeros((n_rows,) + a.shape[1:], dtype=a.dtype)
+    if a.dtype == np.uint64:
+        out[:] = DEFAULT_ID
+    elif a.dtype in (np.int32, np.int64):
+        out[:] = -1
+    return out
+
+
+def _merge_arr(parts, subsets, n_roots, mult):
+    """Interleave per-subset row blocks back into root order: root i's
+    rows live at [i*mult, (i+1)*mult)."""
+    template = next(p for p in parts if p is not None)
+    out = _fill_like(template, n_roots * mult)
+    if template.dtype == np.bool_:
+        out[:] = False
+    for part, idx in zip(parts, subsets):
+        if part is None or not len(idx):
+            continue
+        dest = (idx[:, None] * mult + np.arange(mult)).reshape(-1)
+        out[dest] = part
+    return out
+
+
+def _merge_nb(parts, subsets, n_roots, mult):
+    caps = [p[0].shape[1] for p in parts if p is not None]
+    cap = max(caps)
+    fills = (DEFAULT_ID, np.float32(0.0), np.int32(-1), False)
+    merged = []
+    for j, fill in enumerate(fills):
+        template = next(p for p in parts if p is not None)[j]
+        out = np.full(
+            (n_roots * mult, cap), fill, dtype=template.dtype
+        )
+        for part, idx in zip(parts, subsets):
+            if part is None or not len(idx):
+                continue
+            a = part[j]
+            dest = (idx[:, None] * mult + np.arange(mult)).reshape(-1)
+            out[dest, : a.shape[1]] = a
+        merged.append(out)
+    return tuple(merged)
+
+
+def _merge_results(per_subset, subsets, n_roots) -> dict:
+    first = next(r for r in per_subset if r is not None)
+    out = {}
+    for name, (kind, mult, _) in first.items():
+        parts = [r[name][2] if r is not None else None for r in per_subset]
+        if kind == "arr":
+            out[name] = _merge_arr(parts, subsets, n_roots, mult)
+        elif kind == "nb":
+            out[name] = _merge_nb(parts, subsets, n_roots, mult)
+        else:  # hops: merge each per-hop array independently
+            mults = first[name][1]
+            cols = []
+            for j in range(5):
+                cols.append([
+                    _merge_arr(
+                        [p[j][h] if p is not None else None for p in parts],
+                        subsets, n_roots, mults[h],
+                    )
+                    for h in range(len(mults))
+                ])
+            out[name] = tuple(cols)
+    return out
+
+
+def _untag(results: dict) -> dict:
+    out = {}
+    for name, (kind, _, value) in results.items():
+        out[name] = value if kind != "hops" else tuple(list(v) for v in value)
+    return out
+
+
+def run_plan(graph, plan, roots, seed: int, fused: bool | None = None) -> dict:
+    """Execute a plan over a (possibly remote) Graph: SPLIT roots by
+    owner, one exec_plan RPC per non-empty shard (pipelined through each
+    shard's in-flight executor), MERGE per-alias results in root order.
+    Per-op mode (fused=False / EULER_TPU_FUSED_PLAN=0) drives the same
+    per-subset sub-plans client-side with the same seeds — bit-identical
+    output, ~L×P round trips instead of P."""
+    roots = np.asarray(roots, dtype=np.uint64)
+    if fused is None:
+        fused = _fused_enabled()
+    shards = getattr(graph, "shards", None)
+    remote = shards is not None and all(hasattr(s, "call") for s in shards)
+    num_shards = getattr(graph, "num_shards", 1)
+    if num_shards == 1 or len(roots) == 0:
+        base = subset_seed(seed, 0)
+        if fused and remote and len(roots):
+            try:
+                res = unpack_results(
+                    shards[0].call("exec_plan", [json.dumps(plan), roots, base])
+                )
+            except Exception as e:
+                if "unknown op" not in str(e):
+                    raise
+                res = execute_plan(graph, plan, roots, base)
+        else:
+            res = execute_plan(graph, plan, roots, base)
+        return _untag(res)
+
+    owner = (roots % np.uint64(num_shards)).astype(np.int64)
+    subsets = [np.nonzero(owner == s)[0] for s in range(num_shards)]
+    per_subset: list = [None] * num_shards
+    if fused and remote:
+        plan_json = json.dumps(plan)
+        futs = [
+            shards[s].submit(
+                "exec_plan", [plan_json, roots[idx], subset_seed(seed, s)]
+            )
+            if len(idx)
+            else None
+            for s, idx in enumerate(subsets)
+        ]
+        for s, fut in enumerate(futs):
+            if fut is None:
+                continue
+            try:
+                per_subset[s] = unpack_results(fut.result())
+            except Exception as e:
+                if "unknown op" not in str(e):
+                    raise
+                # server predates exec_plan: same sub-plan, same seed,
+                # driven per-op from here — identical results
+                per_subset[s] = execute_plan(
+                    graph, plan, roots[subsets[s]], subset_seed(seed, s)
+                )
+    else:
+        for s, idx in enumerate(subsets):
+            if len(idx):
+                per_subset[s] = execute_plan(
+                    graph, plan, roots[idx], subset_seed(seed, s)
+                )
+    return _merge_results(per_subset, subsets, len(roots))
+
+
+def is_remote_graph(graph) -> bool:
+    shards = getattr(graph, "shards", None)
+    return bool(shards) and all(hasattr(s, "call") for s in shards)

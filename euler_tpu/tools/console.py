@@ -33,7 +33,21 @@ def main(argv=None) -> int:
     ap.add_argument("--data", default=None, help="local graph directory")
     ap.add_argument("--registry", default=None)
     ap.add_argument("--num-shards", type=int, default=None)
+    ap.add_argument(
+        "--plan",
+        choices=("fused", "per-op", "off"),
+        default=None,
+        help="remote execution mode: fused = one exec_plan RPC per shard"
+        " (default), per-op = one round per step (A/B fallback), off ="
+        " legacy routing; sets EULER_TPU_FUSED_PLAN",
+    )
     args = ap.parse_args(argv)
+    if args.plan is not None:
+        import os
+
+        os.environ["EULER_TPU_FUSED_PLAN"] = {
+            "fused": "1", "per-op": "0", "off": "off"
+        }[args.plan]
     if args.data:
         from euler_tpu.graph import Graph
 
@@ -46,7 +60,10 @@ def main(argv=None) -> int:
         )
     else:
         ap.error("need --data or --registry")
-    print("euler_tpu console — GQL chains; 'quit' to exit")
+    from euler_tpu.query.plan import is_remote_graph, plan_mode
+
+    mode = plan_mode() if is_remote_graph(graph) else "local"
+    print(f"euler_tpu console — GQL chains ({mode} execution); 'quit' to exit")
     while True:
         try:
             line = input("> ").strip()

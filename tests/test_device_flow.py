@@ -233,7 +233,8 @@ def test_weighted_root_distribution():
 
 def test_estimator_trains_and_is_deterministic(graph, flow, fcache, tmp_path):
     # module-scoped flow/cache across runs: fresh Estimators on shared
-    # objects exercise the cross-instance jitted-step cache (_STEP_CACHE)
+    # objects exercise the cross-instance jitted-step cache rooted on the
+    # flow (estimator.py _jit_cache / root._etpu_jit_cache)
 
     def run(steps_per_call):
         est = Estimator(

@@ -606,9 +606,10 @@ def test_remote_rows_and_feature_cache(cluster):
     )
 
 
-def test_remote_fused_fanout_one_rpc(cluster):
-    """The fused fanout reaches the cluster in ONE client RPC; the server
-    coordinates the per-hop shard scatter (remote_op.cc:31-36 parity)."""
+def test_remote_fused_fanout_one_rpc_per_shard(cluster):
+    """The fused fanout reaches the cluster in ONE exec_plan RPC per
+    owner shard (the planner's SPLIT → REMOTE → MERGE, optimizer.h:49-86
+    parity); each server coordinates its subset's per-hop scatter."""
     from euler_tpu.distributed.client import RemoteShard
 
     remote, local, *_ = cluster
@@ -632,7 +633,8 @@ def test_remote_fused_fanout_one_rpc(cluster):
     finally:
         RemoteShard.call = orig
     assert res is not None
-    assert calls == ["sample_fanout"]  # one client RPC for the whole batch
+    # one client RPC per shard for the whole multi-hop batch
+    assert calls == ["exec_plan"] * remote.num_shards
     hop_ids, hop_w, hop_tt, hop_mask, hop_rows = res
     assert [len(h) for h in hop_ids] == [4, 12, 24]
     np.testing.assert_array_equal(hop_ids[0], roots)
@@ -754,7 +756,9 @@ def test_remote_gql_udf_server_side(tmp_path, rng):
         block_resp = shard.call("get_dense_feature", [ids, ["wide"]])
         assert resp_bytes(agg_resp) < resp_bytes(block_resp) / 50
 
-        # the GQL path routes through the pushdown (no full-block fetch)
+        # the GQL path fuses the chain into one exec_plan RPC (the
+        # server aggregates with the pushdown op in-process); the full
+        # feature block never crosses the wire either way
         calls = []
         orig = RemoteShard.call
 
@@ -770,7 +774,7 @@ def test_remote_gql_udf_server_side(tmp_path, rng):
             )
         finally:
             RemoteShard.call = orig
-        assert "dense_feature_udf" in calls
+        assert calls == ["exec_plan"]
         assert "get_dense_feature" not in calls
         np.testing.assert_allclose(
             res["f"].reshape(-1), feats.mean(axis=1), rtol=1e-5

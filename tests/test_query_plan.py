@@ -1,0 +1,277 @@
+"""Distributed query planner: fused per-shard sub-plans (exec_plan).
+
+The contract under test is the reference's SPLIT → per-shard REMOTE
+(fused sub-plan) → MERGE compilation (euler/parser/optimizer.h:49-86,
+remote_op.cc:31-120): an L-step chain on a P-shard cluster costs exactly
+P client RPCs (counter-verified service-side), and the fused execution
+is BIT-IDENTICAL to the per-op fallback (EULER_TPU_FUSED_PLAN=0) under a
+fixed seed — the planner may only move work, never change results."""
+
+import numpy as np
+import pytest
+
+from euler_tpu.distributed import connect, serve_shard
+from euler_tpu.distributed.client import RemoteShard
+from euler_tpu.graph import Graph, convert_json
+from euler_tpu.query import run_gql
+
+ALL_IDS = np.arange(1, 7, dtype=np.uint64)
+
+
+@pytest.fixture(scope="module")
+def plan_cluster(tmp_path_factory, fixture_graph_dict):
+    d = tmp_path_factory.mktemp("plan_cluster")
+    data = str(d / "data")
+    convert_json(fixture_graph_dict, data, num_partitions=2)
+    reg = str(d / "reg")
+    services = [
+        serve_shard(data, 0, registry_path=reg, native=False),
+        serve_shard(data, 1, registry_path=reg, native=False),
+    ]
+    local = Graph.load(data, native=False)
+    remote = connect(registry_path=reg, num_shards=2)
+    yield remote, local, services
+    for s in services:
+        s.stop()
+
+
+def _run_both_modes(monkeypatch, fn):
+    """fn(seeded_rng) under fused then per-op mode, same seed."""
+    monkeypatch.setenv("EULER_TPU_FUSED_PLAN", "1")
+    fused = fn(np.random.default_rng(7))
+    monkeypatch.setenv("EULER_TPU_FUSED_PLAN", "0")
+    per_op = fn(np.random.default_rng(7))
+    return fused, per_op
+
+
+def test_three_step_chain_costs_shard_count_rpcs(plan_cluster):
+    """A ≥3-step remote GQL chain on the 2-shard cluster executes in
+    exactly 2 exec_plan RPCs — one per shard, counter-verified on the
+    SERVICE side (op_counts) and on the client (rpc_count)."""
+    remote, local, services = plan_cluster
+    before_srv = [s.op_counts.get("exec_plan", 0) for s in services]
+    before_cli = [sh.rpc_count for sh in remote.shards]
+    res = run_gql(
+        remote,
+        "v(roots).sampleNB(0, 2).values(dense2).as(f)",  # 3 GQL steps
+        {"roots": ALL_IDS},
+        rng=np.random.default_rng(0),
+    )
+    assert res["f"].shape == (len(ALL_IDS) * 2, 2)
+    srv_delta = [
+        s.op_counts.get("exec_plan", 0) - b
+        for s, b in zip(services, before_srv)
+    ]
+    cli_delta = [sh.rpc_count - b for sh, b in zip(remote.shards, before_cli)]
+    assert srv_delta == [1, 1], srv_delta
+    assert cli_delta == [1, 1], cli_delta
+
+
+def test_single_owner_batch_skips_empty_shards(plan_cluster):
+    """Roots all owned by one shard → one exec_plan RPC total: the SPLIT
+    never pays an RPC for an empty subset."""
+    remote, _, services = plan_cluster
+    even = np.asarray([2, 4, 6], np.uint64)  # owner = id % 2 == 0
+    before = [s.op_counts.get("exec_plan", 0) for s in services]
+    run_gql(remote, "v(roots).sampleNB(0, 2).as(nb)", {"roots": even},
+            rng=np.random.default_rng(0))
+    delta = [
+        s.op_counts.get("exec_plan", 0) - b
+        for s, b in zip(services, before)
+    ]
+    assert delta == [1, 0], delta
+
+
+def test_fused_vs_per_op_bit_identical(plan_cluster, monkeypatch):
+    """Sampling chain: fused and per-op runs with the same seed return
+    bit-identical ids/weights/types/masks and feature blocks."""
+    remote, _, _ = plan_cluster
+    chain = "v(roots).sampleNB(0, 3).as(nb).values(dense2, dense3).as(f)"
+
+    fused, per_op = _run_both_modes(
+        monkeypatch,
+        lambda rng: run_gql(remote, chain, {"roots": ALL_IDS}, rng=rng),
+    )
+    for a, b in zip(fused["nb"], per_op["nb"]):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(fused["f"], per_op["f"])
+
+
+def test_fused_matches_local_deterministic(plan_cluster):
+    """Deterministic chains (full-neighbor expansion, feature fetch,
+    filters) through the planner match the legacy local executor
+    exactly — merged widths, padding, and fills included."""
+    remote, local, _ = plan_cluster
+    for chain in (
+        "v(roots).outV().as(nb)",
+        "v(roots).values(dense2, dense3).as(f)",
+        "v(roots).has(dense2, gt(3)).as(kept)",
+        "v(roots).outV().has(dense2, gt(3)).as(nb)",
+        "v(roots).label().as(t)",
+        "v(roots).has_type(0).get().as(x)",
+        "v(roots).outV().order_by(weight, desc).as(nb)",
+    ):
+        res_r = run_gql(remote, chain, {"roots": ALL_IDS},
+                        rng=np.random.default_rng(0))
+        res_l = run_gql(local, chain, {"roots": ALL_IDS},
+                        rng=np.random.default_rng(0))
+        for key in res_l:
+            a, b = res_r[key], res_l[key]
+            if isinstance(b, tuple):
+                for x, y in zip(a, b):
+                    np.testing.assert_array_equal(x, y)
+            else:
+                np.testing.assert_array_equal(a, b)
+
+
+def test_fanout_plan_parity_and_rows(plan_cluster, monkeypatch):
+    """fanout_with_rows through the planner: fused == per-op bitwise,
+    hop layout unchanged, and the global shard-major rows resolve to the
+    right features."""
+    remote, local, _ = plan_cluster
+    roots = np.asarray([1, 2, 3, 4], np.uint64)
+
+    fused, per_op = _run_both_modes(
+        monkeypatch,
+        lambda rng: remote.fanout_with_rows(roots, None, [3, 2], rng=rng),
+    )
+    for kind_a, kind_b in zip(fused, per_op):
+        for a, b in zip(kind_a, kind_b):
+            np.testing.assert_array_equal(a, b)
+    hop_ids, hop_w, hop_tt, hop_mask, hop_rows = fused
+    assert [len(h) for h in hop_ids] == [4, 12, 24]
+    np.testing.assert_array_equal(hop_ids[0], roots)
+    table = local.dense_feature_table(["dense2"])
+    for hop in range(3):
+        valid = hop_mask[hop] & (hop_rows[hop] >= 0)
+        assert valid.any()
+        np.testing.assert_allclose(
+            table[hop_rows[hop][valid]],
+            local.get_dense_feature(hop_ids[hop][valid], ["dense2"]),
+            rtol=1e-6,
+        )
+    # sampled neighbors are genuine out-neighbors of their roots
+    full, _, _, fmask, _ = local.get_full_neighbor(roots, None)
+    nbr1 = hop_ids[1].reshape(4, 3)
+    m1 = hop_mask[1].reshape(4, 3)
+    for i in range(4):
+        allowed = set(full[i][fmask[i]].tolist())
+        assert set(nbr1[i][m1[i]].tolist()) <= allowed
+
+
+def test_old_server_degrades_to_per_op(plan_cluster, monkeypatch):
+    """A server predating exec_plan ("unknown op") degrades that subset
+    to client-driven per-op execution with the SAME derived seeds —
+    results identical, nothing raises."""
+    remote, _, _ = plan_cluster
+    chain = "v(roots).sampleNB(0, 3).as(nb)"
+    monkeypatch.setenv("EULER_TPU_FUSED_PLAN", "1")
+    want = run_gql(remote, chain, {"roots": ALL_IDS},
+                   rng=np.random.default_rng(5))
+
+    orig = RemoteShard.call
+
+    def no_exec_plan(self, op, values):
+        if op == "exec_plan":
+            from euler_tpu.distributed.client import RpcError
+
+            raise RpcError("ValueError: unknown op 'exec_plan'")
+        return orig(self, op, values)
+
+    monkeypatch.setattr(RemoteShard, "call", no_exec_plan)
+    got = run_gql(remote, chain, {"roots": ALL_IDS},
+                  rng=np.random.default_rng(5))
+    for a, b in zip(want["nb"], got["nb"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_unfusable_chain_keeps_legacy_path(plan_cluster):
+    """Chains outside the fusable set (here: limit, a batch-global step)
+    still run correctly through the per-op legacy executor."""
+    remote, local, _ = plan_cluster
+    from euler_tpu.query import Query
+
+    q = Query("v(roots).outV().limit(2).as(nb)")
+    assert q._remote_plan is None
+    res_r = q.run(remote, {"roots": ALL_IDS}, rng=np.random.default_rng(0))
+    res_l = q.run(local, {"roots": ALL_IDS}, rng=np.random.default_rng(0))
+    for a, b in zip(res_r["nb"], res_l["nb"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_full_neighbor_flow_remote_plan_parity(plan_cluster):
+    """FullNeighborDataFlow against the cluster routes through the
+    planner and reproduces the local flow exactly (features, masks,
+    blocks, true degrees, labels)."""
+    from euler_tpu.dataflow import FullNeighborDataFlow
+
+    remote, local, services = plan_cluster
+    roots = np.asarray([1, 2, 3, 4], np.uint64)
+    kwargs = dict(
+        num_hops=2, max_degree=4, label_feature="dense3", gcn_norm=True
+    )
+    ml = FullNeighborDataFlow(local, ["dense2"], **kwargs).query(roots)
+    before = [s.op_counts.get("exec_plan", 0) for s in services]
+    mr = FullNeighborDataFlow(remote, ["dense2"], **kwargs).query(roots)
+    delta = [
+        s.op_counts.get("exec_plan", 0) - b
+        for s, b in zip(services, before)
+    ]
+    assert sum(delta) == 2  # the WHOLE flow query: one RPC per shard
+    for h in range(3):
+        np.testing.assert_allclose(ml.feats[h], mr.feats[h])
+        np.testing.assert_array_equal(ml.masks[h], mr.masks[h])
+    for bl, br in zip(ml.blocks, mr.blocks):
+        np.testing.assert_allclose(bl.edge_w, br.edge_w)
+        np.testing.assert_array_equal(bl.mask, br.mask)
+        np.testing.assert_allclose(bl.dst_deg, br.dst_deg)
+        np.testing.assert_allclose(bl.src_deg, br.src_deg)
+    np.testing.assert_allclose(ml.labels, mr.labels)
+
+
+def test_exec_plan_coordinators_no_deadlock(plan_cluster, tmp_path):
+    """exec_plan is a coordinator op: two 1-worker servers hit with
+    concurrent exec_plan fan-outs must not deadlock on each other's
+    worker pools (the sample_fanout deadlock rule applies to plans)."""
+    import threading
+
+    _, _, services = plan_cluster
+    remote2 = connect(
+        cluster={
+            0: [("127.0.0.1", services[0].port)],
+            1: [("127.0.0.1", services[1].port)],
+        }
+    )
+    roots = np.asarray([1, 2, 3, 4, 5, 6], np.uint64)
+    results: dict[int, object] = {}
+
+    def hit(i):
+        results[i] = remote2.fanout_with_rows(
+            roots, None, [3, 2], rng=np.random.default_rng(i)
+        )
+
+    threads = [
+        threading.Thread(target=hit, args=(i,), daemon=True)
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), (
+        "exec_plan coordinators deadlocked"
+    )
+    for i in range(4):
+        assert results[i] is not None
+
+
+def test_stats_op_reports_counters(plan_cluster):
+    """The stats wire op exposes the per-op request counters."""
+    import json
+
+    remote, _, services = plan_cluster
+    run_gql(remote, "v(roots).sampleNB(0, 2).as(nb)", {"roots": ALL_IDS},
+            rng=np.random.default_rng(0))
+    stats = json.loads(remote.shards[0].call("stats", [])[0])
+    assert stats["shard"] == 0
+    assert stats["op_counts"].get("exec_plan", 0) >= 1

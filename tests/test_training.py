@@ -371,3 +371,85 @@ def test_jit_step_cache_keying(tmp_path, monkeypatch):
         est(lr=0.3 + i / 100)._train_step_scan()
     assert _flow_probe(flow) is probe, "probe must survive FIFO eviction"
     assert len(flow._etpu_jit_cache) <= _JIT_CACHE_MAX + 1
+
+
+def test_optimizer_key_derived_from_consumed_fields(tmp_path, monkeypatch):
+    """_optimizer_key is derived mechanically from the SAME table
+    make_optimizer consumes (_OPTIMIZER_CFG_FIELDS): perturbing each
+    optimizer-relevant field yields a distinct cached program; a field
+    the update program never reads (momentum under adam) shares."""
+    import dataclasses as dc
+
+    from euler_tpu.estimator.estimator import (
+        _OPTIMIZER_CFG_FIELDS,
+        _optimizer_key,
+        make_optimizer,
+    )
+
+    # key level: every declared optimizer x every consumed field
+    for opt, fields in _OPTIMIZER_CFG_FIELDS.items():
+        base = EstimatorConfig(optimizer=opt)
+        make_optimizer(base)  # the factory accepts every declared name
+        for f in fields:
+            bumped = dc.replace(base, **{f: getattr(base, f) + 0.123})
+            assert _optimizer_key(bumped) != _optimizer_key(base), (opt, f)
+    assert _optimizer_key(
+        EstimatorConfig(optimizer="adam", momentum=0.9)
+    ) == _optimizer_key(EstimatorConfig(optimizer="adam", momentum=0.5))
+
+    # program level: the jit cache resolves the keys to distinct (or
+    # shared) compiled update programs
+    monkeypatch.setenv("EULER_TPU_STEP_CACHE", "1")
+    from euler_tpu.dataflow import DeviceSageFlow
+    from euler_tpu.datasets.synthetic import random_graph
+    from euler_tpu.estimator import DeviceFeatureCache
+    from euler_tpu.models import GraphSAGESupervised
+
+    g = random_graph(num_nodes=60, out_degree=4, feat_dim=4, seed=0)
+    flow = DeviceSageFlow(g, fanouts=[2], batch_size=4, label_feature="label")
+    fcache = DeviceFeatureCache(g, ["feat"])
+
+    def est(**kw):
+        cfg = EstimatorConfig(model_dir=str(tmp_path / "ok"),
+                              log_steps=10**9, **kw)
+        return Estimator(
+            GraphSAGESupervised(dims=[4], label_dim=2), flow, cfg,
+            feature_cache=fcache,
+        )
+
+    base = est(optimizer="momentum", momentum=0.9)._train_step_scan()
+    assert est(
+        optimizer="momentum", momentum=0.5
+    )._train_step_scan() is not base, "momentum feeds sgd(momentum=...)"
+    adam = est(optimizer="adam", momentum=0.9)._train_step_scan()
+    assert est(optimizer="adam", momentum=0.5)._train_step_scan() is adam, (
+        "adam never reads momentum — same program must be shared"
+    )
+
+
+def test_model_key_structural_not_repr(tmp_path):
+    """_model_key must not rely on repr(model): numpy summarizes large
+    arrays, so two different big constants repr identically — a silent
+    wrong-program share. The structural key distinguishes them, keys
+    equal configs equally, and stays hashable."""
+    from euler_tpu.estimator.estimator import _structural_key
+    from euler_tpu.models import GraphSAGESupervised
+
+    a = np.zeros(5000, np.float32)
+    b = a.copy()
+    b[2500] = 1.0
+    assert repr(a) == repr(b), "precondition: repr collides when summarized"
+    assert _structural_key(a) != _structural_key(b)
+
+    m1 = GraphSAGESupervised(dims=[8, 8], label_dim=2)
+    m2 = GraphSAGESupervised(dims=[8, 8], label_dim=2)
+    m3 = GraphSAGESupervised(dims=[16], label_dim=2)
+    k1, k2, k3 = map(_structural_key, (m1, m2, m3))
+    assert k1 == k2 and k1 != k3
+    hash(k1)  # cache keys must be hashable
+    # dict-valued fields (conv_kwargs carrying a dtype) key structurally
+    m4 = GraphSAGESupervised(
+        dims=[8, 8], label_dim=2, conv_kwargs={"dtype": jnp.bfloat16}
+    )
+    assert _structural_key(m4) != k1
+    hash(_structural_key(m4))
