@@ -15,6 +15,7 @@ Public surface (mirrors tf_euler/python/euler_ops + model libs):
     euler_tpu.layers     — convolution layers (GCN/SAGE/GAT/GIN/...)
     euler_tpu.nn         — GNN nets, heads, encoders, aggregators, metrics
     euler_tpu.estimator  — train/evaluate/infer drivers
+    euler_tpu.serving    — online model server (micro-batched predict RPCs)
     euler_tpu.parallel   — mesh/sharding helpers, sharded embedding tables
     euler_tpu.datasets   — auto-download dataset pipelines
 """

@@ -110,6 +110,26 @@ class DataFlow:
     def query(self, roots: np.ndarray) -> MiniBatch:
         raise NotImplementedError
 
+    def query_padded(
+        self, roots: np.ndarray, batch_size: int
+    ) -> tuple[MiniBatch, int]:
+        """query() at a FIXED root count: pads `roots` to `batch_size` by
+        repeating the final id, so callers with variable request sizes
+        (online serving buckets, tail inference chunks) always execute the
+        one program compiled for that size. Returns (batch, n_valid) —
+        rows [n_valid:] of the output are padding and must be sliced off."""
+        roots = np.asarray(roots, dtype=np.uint64)
+        n = len(roots)
+        if n == 0 or n > batch_size:
+            raise ValueError(
+                f"need 1..{batch_size} roots for this bucket, got {n}"
+            )
+        if n < batch_size:
+            roots = np.concatenate(
+                [roots, np.repeat(roots[-1:], batch_size - n)]
+            )
+        return self.query(roots), n
+
 
 def fanout_block(
     batch: int,

@@ -16,6 +16,7 @@ import functools
 import os
 import threading
 import time
+import weakref
 from typing import Callable, Iterable, Iterator
 
 import jax
@@ -98,18 +99,22 @@ def _optimizer_key(cfg: EstimatorConfig) -> tuple:
 # lowering an identical train step costs seconds per instance on a host
 # core even when the persistent compile cache spares the XLA compile
 # (re-instantiation patterns: determinism reruns, warm-started TransX
-# chains, hyperparameter sweeps). The cache dict is rooted ON the user's
-# flow (else feature-cache) object — not in a global — so the cached
-# closures never outlive the objects whose device buffers they pin: drop
-# the flow/cache and every program traced against it is freed with it.
-# Entries are keyed by everything else the traced program reads: the flax
-# model (structural repr — configs are ints/strings), the cfg fields
-# make_optimizer consumes, rng collections, the mesh, and the identity of
-# the non-root partner object (its id cannot be recycled while the entry
-# exists, because the closure holds it). Estimators with neither a device
-# flow nor a feature cache have no root to pin the lifetime to and simply
-# keep the pre-existing per-instance behavior. EULER_TPU_STEP_CACHE=0
-# disables all sharing.
+# chains, hyperparameter sweeps, serving runtimes). The cache dict is
+# keyed BY the user's flow (else feature-cache) object in a module-level
+# WeakKeyDictionary — not injected as an attribute onto the user's object
+# (ADVICE r5: attribute injection broke copy.deepcopy/pickle of flows
+# after training) and not a strong global — so the cached closures never
+# outlive the objects whose device buffers they pin: drop the flow/cache
+# and the weak entry (and every program traced against it) is freed with
+# it. Entries are keyed by everything else the traced program reads: the
+# flax model (structural digest), the cfg fields make_optimizer consumes,
+# rng collections, the mesh, and the identity of the non-root partner
+# object (its id cannot be recycled while the entry exists, because the
+# closure holds it). Estimators with neither a device flow nor a feature
+# cache have no root to pin the lifetime to and simply keep the
+# pre-existing per-instance behavior. Get-or-build runs under
+# _JIT_CACHE_LOCK so concurrent serving threads can't race a build.
+# EULER_TPU_STEP_CACHE=0 disables all sharing.
 
 
 def _structural_key(v):
@@ -180,18 +185,26 @@ def _structural_key(v):
 _JIT_CACHE_MAX = 8
 
 
+_JIT_CACHES: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# one process-wide reentrant lock over every get-or-build: build work under
+# it is cheap (jax.jit only wraps; tracing happens at first call), and a
+# single lock cannot deadlock against itself on the nested
+# _ensure_steps → _jit_cache path
+_JIT_CACHE_LOCK = threading.RLock()
+
+
 def _jit_cache(root) -> dict | None:
     """The per-object jit-program cache rooted on `root`, or None when
     sharing is off / there is no root."""
     if root is None or os.environ.get("EULER_TPU_STEP_CACHE", "1") == "0":
         return None
-    cache = getattr(root, "_etpu_jit_cache", None)
-    if cache is None:
-        cache = {}
-        try:
-            root._etpu_jit_cache = cache
-        except AttributeError:  # __slots__ or frozen object: no sharing
-            return None
+    with _JIT_CACHE_LOCK:
+        cache = _JIT_CACHES.get(root)
+        if cache is None:
+            try:
+                _JIT_CACHES[root] = cache = {}
+            except TypeError:  # not weak-referenceable: no sharing
+                return None
     return cache
 
 
@@ -212,9 +225,10 @@ def _flow_probe(flow):
     cache = _jit_cache(flow)
     if cache is None:
         return jax.jit(flow.sample)
-    if "probe" not in cache:
-        _jit_cache_put(cache, "probe", jax.jit(flow.sample))
-    return cache["probe"]
+    with _JIT_CACHE_LOCK:
+        if "probe" not in cache:
+            _jit_cache_put(cache, "probe", jax.jit(flow.sample))
+        return cache["probe"]
 
 
 def _hydrate_batch(feature_cache, batch: tuple) -> tuple:
@@ -447,27 +461,34 @@ class Estimator:
             else self.feature_cache
         )
         cache = _jit_cache(root)
-        key = None
-        if cache is not None:
-            key = (
-                "steps",
-                self._model_key(),
-                _optimizer_key(self.cfg),
-                self._rng_names,
-                id(self.feature_cache)
-                if self.feature_cache is not None and root is not self.feature_cache
-                else None,
-                self.mesh,
+        if cache is None:
+            self._jit_train, self._jit_train_scan = _build_train_steps(
+                self.model, self.tx, self._device_flow, self.feature_cache
             )
-            if key in cache:
-                self._jit_train, self._jit_train_scan = cache[key]
-                return
-        steps = _build_train_steps(
-            self.model, self.tx, self._device_flow, self.feature_cache
+            return
+        key = (
+            "steps",
+            self._model_key(),
+            _optimizer_key(self.cfg),
+            self._rng_names,
+            id(self.feature_cache)
+            if self.feature_cache is not None and root is not self.feature_cache
+            else None,
+            self.mesh,
         )
-        self._jit_train, self._jit_train_scan = steps
-        if cache is not None:
-            _jit_cache_put(cache, key, steps)
+        # get-or-build under the lock: two serving/training threads racing
+        # here must agree on ONE program pair, not each build-and-overwrite
+        with _JIT_CACHE_LOCK:
+            if key not in cache:
+                _jit_cache_put(
+                    cache,
+                    key,
+                    _build_train_steps(
+                        self.model, self.tx, self._device_flow,
+                        self.feature_cache,
+                    ),
+                )
+            self._jit_train, self._jit_train_scan = cache[key]
 
     def _train_step(self):
         self._ensure_steps()
@@ -646,9 +667,10 @@ class Estimator:
         if cache is None:
             return build()
         key = (kind, self._model_key(), self._rng_names)
-        if key not in cache:
-            _jit_cache_put(cache, key, build())
-        return cache[key]
+        with _JIT_CACHE_LOCK:
+            if key not in cache:
+                _jit_cache_put(cache, key, build())
+            return cache[key]
 
     def evaluate(self, batches: Iterable[tuple]) -> dict:
         self._ensure_init()
@@ -681,11 +703,11 @@ class Estimator:
             (name or "metric"): float(np.mean(metrics)) if metrics else float("nan"),
         }
 
-    def infer(
-        self, batches: Iterable[tuple], ids: Iterable[np.ndarray], worker: int = 0
-    ) -> tuple[np.ndarray, np.ndarray]:
-        """Embeds batches; writes embedding_{worker}.npy + ids_{worker}.npy."""
-        self._ensure_init()
+    def embed_program(self):
+        """The jitted `(params, batch) -> embeddings` program `infer` runs —
+        shared across instances via the feature-cache-rooted jit cache, and
+        the program the serving runtime executes so served predictions are
+        bit-identical to offline `infer` on the same checkpoint."""
         if self._jit_embed is None:
             model, fc = self.model, self.feature_cache
             self._jit_embed = self._shared_apply_jit(
@@ -696,6 +718,14 @@ class Estimator:
                     )
                 ),
             )
+        return self._jit_embed
+
+    def infer(
+        self, batches: Iterable[tuple], ids: Iterable[np.ndarray], worker: int = 0
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Embeds batches; writes embedding_{worker}.npy + ids_{worker}.npy."""
+        self._ensure_init()
+        self.embed_program()
         embs, all_ids = [], []
         for batch, chunk_ids in zip(batches, ids):
             batch = self._put(batch)
@@ -747,8 +777,13 @@ class Estimator:
         ckpt = ocp.PyTreeCheckpointer()
         # pre-opt_state checkpoints carry only params+step: detect by the
         # checkpoint's own metadata, so genuine restore errors propagate
-        # instead of silently resetting optimizer slots
-        has_opt = "opt_state" in set(ckpt.metadata(path).item_metadata.keys())
+        # instead of silently resetting optimizer slots. Orbax returns the
+        # tree metadata as a plain dict (>=0.7) or wrapped in an object
+        # with .item_metadata (older releases).
+        meta = ckpt.metadata(path)
+        if not hasattr(meta, "keys"):
+            meta = meta.item_metadata
+        has_opt = "opt_state" in set(meta.keys())
 
         def _args(tpl):
             # restore each leaf straight onto the live tree's sharding

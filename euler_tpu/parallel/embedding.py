@@ -39,7 +39,7 @@ def sharded_lookup(mesh: Mesh, table: jax.Array, ids: jax.Array) -> jax.Array:
     mesh (out_specs=P()). Out-of-range ids belong to no shard, so their
     output rows are all-zero (and receive zero gradient).
     """
-    from jax import shard_map
+    from euler_tpu.parallel.mesh import shard_map
 
     nparts = mesh.shape[MODEL_AXIS]
     rows_per = table.shape[0] // nparts

@@ -36,7 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from euler_tpu.ops import scatter_add
-from euler_tpu.parallel.mesh import MODEL_AXIS
+from euler_tpu.parallel.mesh import MODEL_AXIS, shard_map
 
 
 def sp_segment_sum(
@@ -52,7 +52,7 @@ def sp_segment_sum(
         mask = jnp.ones(dst.shape[0], dtype=bool)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis)),
         out_specs=P(),
@@ -207,7 +207,7 @@ def ring_segment_sum(
     parts = mesh.shape[axis]
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
         out_specs=P(axis),

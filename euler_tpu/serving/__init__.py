@@ -1,0 +1,20 @@
+"""Online inference serving: micro-batched, admission-controlled GNN
+model server.
+
+    InferenceRuntime — checkpoint + model + dataflow, compiled per bucket
+    MicroBatcher     — coalesce concurrent requests into one device step
+    ModelServer      — predict/server_stats wire verbs (pooled-TCP stack)
+    ServingClient    — retrying client with typed fast-fail errors
+
+See SCALE.md "Online serving" for the batching policy and overload
+semantics, and `python -m euler_tpu.tools.serve` for the CLI.
+"""
+
+from euler_tpu.serving.batcher import (  # noqa: F401
+    DeadlineExceededError,
+    MicroBatcher,
+    OverloadError,
+)
+from euler_tpu.serving.client import ServingClient  # noqa: F401
+from euler_tpu.serving.runtime import InferenceRuntime  # noqa: F401
+from euler_tpu.serving.server import ModelServer  # noqa: F401

@@ -1,0 +1,82 @@
+"""ServingClient — predict() against a ModelServer, with retries.
+
+Transport is the graph client's replica pool (distributed/client.py
+RemoteShard): round-robin replicas with bad-host quarantine + timed
+revival, bounded retries for TRANSPORT faults only. Server-side
+decisions come back as "err" frames and are re-raised typed without
+retry: OverloadError and DeadlineExceededError are deterministic
+admission/deadline verdicts — retrying them at the transport layer would
+amplify exactly the overload they signal. Callers own backoff policy.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from euler_tpu.distributed.client import RemoteShard, RpcError
+from euler_tpu.serving.batcher import DeadlineExceededError, OverloadError
+
+_TYPED_ERRORS = {
+    "OverloadError": OverloadError,
+    "DeadlineExceededError": DeadlineExceededError,
+}
+
+
+def _raise_typed(err: RpcError):
+    msg = str(err)
+    name = msg.split(":", 1)[0].strip()
+    cls = _TYPED_ERRORS.get(name)
+    if cls is not None:
+        raise cls(msg.split(":", 1)[1].strip()) from None
+    raise err
+
+
+class ServingClient:
+    """Client for one model served by N replicas."""
+
+    def __init__(self, replicas, deadline_ms: float | None = None):
+        """replicas: (host, port) or [(host, port), ...].
+        deadline_ms: default per-request deadline shipped to the server
+        (None = requests wait as long as the transport allows)."""
+        if isinstance(replicas, tuple) and len(replicas) == 2 and isinstance(
+            replicas[0], str
+        ):
+            replicas = [replicas]
+        self._pool = RemoteShard(0, list(replicas))
+        self.deadline_ms = deadline_ms
+
+    @property
+    def rpc_count(self) -> int:
+        return self._pool.rpc_count
+
+    def _call(self, op: str, values: list) -> list:
+        try:
+            return self._pool.call(op, values)
+        except RpcError as e:
+            _raise_typed(e)
+
+    # -- surface ---------------------------------------------------------
+
+    def predict(
+        self, node_ids, deadline_ms: float | None = None
+    ) -> np.ndarray:
+        """Embeddings for node_ids ([n, D]); raises OverloadError /
+        DeadlineExceededError on fast-fail verdicts."""
+        ids = np.asarray(node_ids, dtype=np.uint64).reshape(-1)
+        dl = deadline_ms if deadline_ms is not None else self.deadline_ms
+        return self._call(
+            "predict", [ids, float(dl) if dl is not None else None]
+        )[0]
+
+    def stats(self) -> dict:
+        return json.loads(self._call("server_stats", [])[0])
+
+    def ping(self) -> bool:
+        return self._call("ping", []) == [0]
+
+    def close(self):
+        for r in self._pool.replicas:
+            r.drop()
+        self._pool.close()

@@ -1,0 +1,116 @@
+"""ModelServer — `predict`/`server_stats` wire verbs over the pooled-TCP
+stack.
+
+Reuses the graph service's `_PoolServer` (distributed/service.py): a
+selector thread parks idle connections, a bounded worker pool runs the
+request cycle. Each worker blocks on its request's future while the
+micro-batcher coalesces every in-flight worker's request into one device
+step — the pool's concurrency IS the batching window. No coordinator
+threads (a model server never fans out to peers).
+
+Verbs:
+  predict      [ids u64[n], deadline_ms float|None] → [emb f32[n, D]]
+  server_stats []                                   → [json]
+  ping         []                                   → [0]
+
+Overload and deadline rejections ride the existing "err" status frame
+with a typed prefix ("OverloadError: ...", "DeadlineExceededError: ...")
+so ServingClient re-raises the typed exception instead of a generic
+RpcError — and never failover-retries either (they are deterministic
+server decisions, not transport faults).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from euler_tpu.distributed.service import _PoolServer
+from euler_tpu.serving.batcher import MicroBatcher
+
+
+class ModelServer:
+    """Serves one InferenceRuntime over the wire protocol."""
+
+    def __init__(
+        self,
+        runtime,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_batch: int | None = None,
+        max_wait_us: int = 2000,
+        max_queue: int = 256,
+        workers: int | None = None,
+        registry=None,
+        shard: int = 0,
+    ):
+        self.runtime = runtime
+        if max_batch is None:
+            max_batch = max(getattr(runtime, "buckets", (128,)))
+        self.batcher = MicroBatcher(
+            runtime,
+            max_batch=max_batch,
+            max_wait_us=max_wait_us,
+            max_queue=max_queue,
+        )
+        self.may_coordinate = False  # _PoolServer: no coordinator threads
+        if workers is None:
+            # graph-service sizing (cpu*2) is for CPU-bound store ops; a
+            # serving worker spends its life parked on a batcher future
+            # while the DEVICE computes, and the number of workers is the
+            # coalescing window — size for concurrency, not cores
+            import os
+
+            workers = min(64, max(16, (os.cpu_count() or 1) * 4))
+        self.server = _PoolServer((host, port), self, workers)
+        self.host, self.port = self.server.server_address
+        self.registry = registry
+        self.shard = shard
+        self._beat = None
+        self._started = time.monotonic()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        self.server.start()
+        if self.registry is not None:
+            self._beat = self.registry.register(
+                self.shard, self.host, self.port
+            )
+        return self
+
+    def stop(self):
+        if self._beat is not None:
+            self._beat.set()
+        self.server.shutdown()
+        self.server.server_close()
+        self.batcher.close()
+
+    # -- _PoolServer service surface -------------------------------------
+
+    def is_coordinator(self, op: str) -> bool:
+        return False
+
+    def dispatch(self, op: str, a: list) -> list:
+        if op == "predict":
+            deadline_ms = a[1] if len(a) > 1 else None
+            deadline = (
+                time.monotonic() + float(deadline_ms) / 1e3
+                if deadline_ms
+                else None
+            )
+            # admission control raises OverloadError HERE (fast-fail);
+            # otherwise the worker blocks on the future while the batcher
+            # coalesces it with the other in-flight workers' requests
+            return [self.batcher.predict(a[0], deadline)]
+        if op == "server_stats":
+            stats = self.batcher.stats()
+            stats.update(
+                device_batches=getattr(self.runtime, "device_batches", None),
+                buckets=list(getattr(self.runtime, "buckets", ())),
+                uptime_s=round(time.monotonic() - self._started, 3),
+            )
+            return [json.dumps(stats)]
+        if op == "ping":
+            return [0]
+        raise ValueError(f"unknown op {op!r}")

@@ -33,3 +33,18 @@ def test_bench_smoke_emits_final_json_line():
     assert row["unit"] == "edges/s"
     assert "vs_baseline" in row and "backend" in row
     assert row["device_flow"] is True  # smoke covers the production default
+    # the serving lane rode along: its own JSON line with latency
+    # percentiles and the coalescing ratio, plus a summary on the
+    # re-emitted headline
+    serving = [
+        json.loads(ln)
+        for ln in json_lines
+        if json.loads(ln).get("metric") == "gnn_serving_requests_per_sec"
+    ]
+    assert serving, json_lines
+    srow = serving[-1]
+    assert srow["value"] > 0 and srow["unit"] == "req/s"
+    assert srow["p50_ms"] > 0 and srow["p99_ms"] >= srow["p50_ms"]
+    # the micro-batcher must actually coalesce under 8 concurrent clients
+    assert 0 < srow["batches_per_100_requests"] < 100
+    assert row["serving_requests_per_sec"] == srow["value"]

@@ -1,0 +1,434 @@
+"""Online serving subsystem: micro-batched model server end to end.
+
+The contract under test is the ISSUE-2 acceptance bar: concurrent served
+predictions bit-identical to offline `Estimator.infer` on the same
+checkpoint, coalescing counter-verified (device batches < requests),
+overload fast-fails instead of hanging, expired deadlines are rejected,
+and the server survives a client disconnect mid-request.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.dataflow import FullNeighborDataFlow
+from euler_tpu.estimator import (
+    Estimator,
+    EstimatorConfig,
+    id_batches,
+    node_batches,
+)
+from euler_tpu.graph import Graph
+from euler_tpu.models import GraphSAGESupervised
+from euler_tpu.serving import (
+    DeadlineExceededError,
+    InferenceRuntime,
+    MicroBatcher,
+    ModelServer,
+    OverloadError,
+    ServingClient,
+)
+
+N_NODES = 48
+BUCKET = 16
+ALL_IDS = np.arange(1, N_NODES + 1, dtype=np.uint64)
+
+
+def _ring_graph(n=N_NODES, seed=0):
+    rng = np.random.default_rng(seed)
+    nodes = [
+        {
+            "id": i + 1,
+            "type": 0,
+            "weight": 1.0,
+            "features": [
+                {"name": "feat", "type": "dense",
+                 "value": rng.normal(size=4).tolist()},
+                {"name": "label", "type": "dense",
+                 "value": [1.0, 0.0] if i % 2 else [0.0, 1.0]},
+            ],
+        }
+        for i in range(n)
+    ]
+    edges = [
+        {"src": i + 1, "dst": (i + d) % n + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for i in range(n)
+        for d in (1, 2, 3)
+    ]
+    return Graph.from_json({"nodes": nodes, "edges": edges})
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """One trained checkpoint + runtime + live server, shared per module.
+
+    FullNeighborDataFlow is deterministic per root, so the served
+    subgraphs are replayable — the precondition for bit-parity."""
+    graph = _ring_graph()
+    flow = FullNeighborDataFlow(
+        graph, ["feat"], num_hops=2, max_degree=4, label_feature="label"
+    )
+    model = GraphSAGESupervised(dims=[8, 8], label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path_factory.mktemp("serving") / "ckpt"),
+        total_steps=2,
+        log_steps=10**9,
+    )
+    est = Estimator(
+        model, node_batches(graph, flow, BUCKET, rng=np.random.default_rng(1)),
+        cfg,
+    )
+    est.train(log=False)
+    runtime = InferenceRuntime(model, flow, cfg, buckets=(BUCKET,))
+    runtime.warmup()
+    server = ModelServer(runtime, max_wait_us=5000).start()
+    yield graph, flow, model, cfg, est, runtime, server
+    server.stop()
+
+
+def _direct_infer(est, flow):
+    batches, chunks = id_batches(flow, ALL_IDS, BUCKET)
+    _, emb = est.infer(batches, chunks)
+    return emb
+
+
+def test_concurrent_parity_and_coalescing(served):
+    """≥8 concurrent clients: served == offline infer bit-for-bit, and
+    the batcher executed FEWER device batches than requests (the
+    micro-batching claim, counter-verified via server_stats)."""
+    _, flow, _, _, est, runtime, server = served
+    direct = _direct_infer(est, flow)
+    before = ServingClient((server.host, server.port))
+    stats0 = before.stats()
+    before.close()
+
+    results, errors = {}, []
+
+    def worker(k):
+        client = ServingClient((server.host, server.port))
+        try:
+            # 4 sequential requests of 6 ids per client → 32 requests
+            for j in range(4):
+                ids = np.roll(ALL_IDS, k * 6 + j)[: 6]
+                results[(k, j)] = (ids, client.predict(ids))
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(results) == 32
+    for ids, emb in results.values():
+        ref = direct[ids.astype(np.int64) - 1]
+        assert emb.dtype == ref.dtype
+        assert np.array_equal(emb, ref), (
+            "served prediction differs from offline infer"
+        )
+    after = ServingClient((server.host, server.port))
+    stats = after.stats()
+    after.close()
+    requests = stats["requests"] - stats0["requests"]
+    batches = stats["batches"] - stats0["batches"]
+    assert requests == 32
+    assert batches < requests, (
+        f"micro-batcher never coalesced: {batches} batches for "
+        f"{requests} requests"
+    )
+
+
+def test_single_request_matches_direct(served):
+    _, flow, _, _, est, _, server = served
+    direct = _direct_infer(est, flow)
+    client = ServingClient((server.host, server.port))
+    try:
+        emb = client.predict(ALL_IDS[:3])
+        assert emb.shape == (3, 8)
+        assert np.array_equal(emb, direct[:3])
+        assert client.ping()
+    finally:
+        client.close()
+
+
+def test_oversized_request_chunks(served):
+    """A request larger than the biggest bucket still answers (the
+    runtime chunks it), rows aligned with the requested ids."""
+    _, flow, _, _, est, _, server = served
+    direct = _direct_infer(est, flow)
+    client = ServingClient((server.host, server.port))
+    try:
+        emb = client.predict(ALL_IDS)  # 48 ids > bucket 16
+        assert emb.shape == (N_NODES, 8)
+        assert np.array_equal(emb, direct)
+    finally:
+        client.close()
+
+
+def test_runtime_reuses_shared_embed_program(tmp_path):
+    """Production serving config (rows-mode flow + DeviceFeatureCache):
+    the runtime's predict program IS the estimator's infer program —
+    shared through the feature-cache-rooted jit cache, so serving cannot
+    drift from offline inference even in principle."""
+    from euler_tpu.estimator import DeviceFeatureCache
+
+    graph = _ring_graph()
+    fc = DeviceFeatureCache(graph, ["feat"])
+    flow = FullNeighborDataFlow(
+        graph, ["feat"], num_hops=2, max_degree=4,
+        label_feature="label", feature_mode="rows",
+    )
+    model = GraphSAGESupervised(dims=[8, 8], label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "shared"), total_steps=1, log_steps=10**9
+    )
+    est = Estimator(
+        model, node_batches(graph, flow, BUCKET, rng=np.random.default_rng(1)),
+        cfg, feature_cache=fc,
+    )
+    est.train(log=False, save=False)
+    runtime = InferenceRuntime(
+        model, flow, cfg, feature_cache=fc, buckets=(BUCKET,),
+        params=est.params,
+    )
+    assert runtime._embed is est.embed_program(), (
+        "runtime must reuse the cross-instance jit cache entry"
+    )
+    direct = _direct_infer(est, flow)
+    np.testing.assert_array_equal(runtime.predict(ALL_IDS[:5]), direct[:5])
+
+
+class _SlowRuntime:
+    """Duck-typed runtime: predictable stall, for overload/deadline tests."""
+
+    def __init__(self, delay_s):
+        self.delay_s = delay_s
+        self.device_batches = 0
+        self.buckets = (8,)
+
+    def predict(self, ids):
+        time.sleep(self.delay_s)
+        self.device_batches += 1
+        return np.zeros((len(ids), 2), np.float32)
+
+
+def test_overload_fast_fails_not_hangs():
+    """Admission control: with the queue full, submit() refuses in
+    milliseconds instead of queueing unboundedly."""
+    batcher = MicroBatcher(
+        _SlowRuntime(0.3), max_batch=1, max_wait_us=0, max_queue=2
+    )
+    try:
+        t0 = time.monotonic()
+        futures = []
+        with pytest.raises(OverloadError):
+            for _ in range(20):  # the queue is bounded at 2: filling must
+                # trip admission control long before 20
+                futures.append(batcher.submit(np.ones(1, np.uint64)))
+        assert time.monotonic() - t0 < 1.0, "overload answer must be fast"
+        assert futures, "at least the first request must be admitted"
+        stats = batcher.stats()
+        assert stats["rejected_overload"] >= 1
+        for f in futures:  # admitted work still completes
+            assert f.result(timeout=10).shape == (1, 2)
+    finally:
+        batcher.close()
+
+
+class _GatedRuntime:
+    """Device blocked until the test opens the gate — overload/deadline
+    behavior becomes deterministic, not timing-dependent."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.device_batches = 0
+        self.buckets = (8,)
+
+    def predict(self, ids):
+        assert self.gate.wait(timeout=30), "test never opened the gate"
+        self.device_batches += 1
+        return np.zeros((len(ids), 2), np.float32)
+
+
+def test_overload_fast_fails_over_the_wire():
+    """The OverloadError crosses the wire typed: with the device provably
+    still busy (gate closed), saturated requests come back rejected —
+    fast-fail, not hang — and the client raises OverloadError without
+    failover retries (retrying amplifies overload)."""
+    runtime = _GatedRuntime()
+    server = ModelServer(
+        runtime, max_batch=1, max_wait_us=0, max_queue=1, workers=8
+    ).start()
+    outcomes: dict = {}
+
+    def attempt(k):
+        client = ServingClient((server.host, server.port))
+        try:
+            client.predict(np.ones(1, np.uint64))
+            outcomes[k] = "ok"
+        except OverloadError:
+            outcomes[k] = "overload"
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=attempt, args=(k,)) for k in range(6)]
+    try:
+        for t in threads:
+            t.start()
+        # 1 request on the (blocked) device + 1 in the bounded queue; the
+        # other >=4 MUST come back rejected while the gate is still closed
+        deadline = time.monotonic() + 10
+        while (
+            sum(v == "overload" for v in outcomes.values()) < 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        rejected = sum(v == "overload" for v in outcomes.values())
+        assert rejected >= 4, (
+            f"only {rejected} rejections with the device blocked: "
+            f"{outcomes}"
+        )
+        assert runtime.device_batches == 0, (
+            "rejections must not touch the device"
+        )
+    finally:
+        runtime.gate.set()  # release the admitted requests
+        for t in threads:
+            t.join()
+        server.stop()
+    assert sum(v == "ok" for v in outcomes.values()) >= 1, outcomes
+
+
+def test_deadline_expired_rejected():
+    """A request whose deadline passes while queued is rejected at
+    dispatch without touching the device."""
+    runtime = _GatedRuntime()
+    server = ModelServer(
+        runtime, max_batch=1, max_wait_us=0, max_queue=8, workers=8
+    ).start()
+    a = ServingClient((server.host, server.port))
+    b = ServingClient((server.host, server.port))
+    try:
+        hold = threading.Thread(
+            target=lambda: a.predict(np.ones(1, np.uint64))
+        )
+        hold.start()
+        # wait until A occupies the (gate-blocked) device, so B queues
+        # BEHIND it deterministically
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            s = b.stats()
+            if s["requests"] >= 1 and s["pending"] == 0:
+                break
+            time.sleep(0.01)
+        # open the gate well after B's 50ms deadline has lapsed; A then
+        # finishes and the dispatcher reaches B only once it is expired
+        threading.Timer(0.3, runtime.gate.set).start()
+        with pytest.raises(DeadlineExceededError):
+            b.predict(np.ones(1, np.uint64), deadline_ms=50)
+        hold.join()
+        stats = b.stats()
+        assert stats["rejected_deadline"] >= 1
+        # the rejected request consumed no device batch (only A's)
+        assert runtime.device_batches == 1
+    finally:
+        runtime.gate.set()
+        a.close()
+        b.close()
+        server.stop()
+
+
+def test_client_disconnect_mid_request(served):
+    """A client that sends predict and hangs up before the response must
+    cost only its connection — the server keeps answering others."""
+    from euler_tpu.distributed import wire
+
+    _, flow, _, _, est, _, server = served
+    sock = socket.create_connection((server.host, server.port), timeout=10)
+    sock.sendall(wire.encode("predict", [ALL_IDS[:4], None]))
+    sock.close()  # vanish mid-request
+    time.sleep(0.2)
+    client = ServingClient((server.host, server.port))
+    try:
+        emb = client.predict(ALL_IDS[:4])
+        assert emb.shape == (4, 8)
+        assert client.ping()
+    finally:
+        client.close()
+
+
+def test_unknown_op_is_clean_error(served):
+    from euler_tpu.distributed.client import RpcError
+
+    *_, server = served
+    client = ServingClient((server.host, server.port))
+    try:
+        with pytest.raises(RpcError, match="unknown op"):
+            client._call("no_such_verb", [])
+    finally:
+        client.close()
+
+
+def test_serve_selftest_cli():
+    """`python -m euler_tpu.tools.serve --selftest` boots server+client
+    in-process and exits 0 — the deployment smoke, wired as a fast test."""
+    import os
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "euler_tpu.tools.serve", "--selftest"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert '"selftest": "ok"' in r.stdout
+
+
+@pytest.mark.slow
+def test_serving_soak(served):
+    """Soak: sustained concurrent load, every answer bit-identical, no
+    worker/connection leaks, coalescing holds up over time."""
+    _, flow, _, _, est, _, server = served
+    direct = _direct_infer(est, flow)
+    stop = time.monotonic() + 8.0
+    errors: list = []
+    counts = [0] * 8
+
+    def worker(k):
+        client = ServingClient((server.host, server.port))
+        rng = np.random.default_rng(k)
+        try:
+            while time.monotonic() < stop:
+                ids = rng.choice(ALL_IDS, size=6, replace=False)
+                emb = client.predict(ids)
+                if not np.array_equal(emb, direct[ids.astype(np.int64) - 1]):
+                    errors.append(f"mismatch in client {k}")
+                    return
+                counts[k] += 1
+        except Exception as e:
+            errors.append(repr(e))
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert min(counts) > 0, counts
+    client = ServingClient((server.host, server.port))
+    stats = client.stats()
+    client.close()
+    assert stats["batches"] < stats["requests"]
+    assert stats["errors"] == 0

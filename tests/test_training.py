@@ -364,13 +364,20 @@ def test_jit_step_cache_keying(tmp_path, monkeypatch):
         total_steps=4, log=False, save=False
     )
     # eviction never recycles the flow's init-shape probe
-    from euler_tpu.estimator.estimator import _JIT_CACHE_MAX, _flow_probe
+    from euler_tpu.estimator.estimator import (
+        _JIT_CACHE_MAX,
+        _flow_probe,
+        _jit_cache,
+    )
 
     probe = _flow_probe(flow)
     for i in range(_JIT_CACHE_MAX + 3):
         est(lr=0.3 + i / 100)._train_step_scan()
     assert _flow_probe(flow) is probe, "probe must survive FIFO eviction"
-    assert len(flow._etpu_jit_cache) <= _JIT_CACHE_MAX + 1
+    assert len(_jit_cache(flow)) <= _JIT_CACHE_MAX + 1
+    # the cache is a weak side table, NOT an attribute injected onto the
+    # user's flow (ADVICE r5: injection broke deepcopy/pickle after use)
+    assert not hasattr(flow, "_etpu_jit_cache")
 
 
 def test_optimizer_key_derived_from_consumed_fields(tmp_path, monkeypatch):
