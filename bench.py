@@ -376,8 +376,18 @@ def run(platform: str) -> tuple[float, dict]:
             feature_mode="rows", lean=True,
         )
 
+        # fresh Generator per call because batch_fn runs on prefetch
+        # producer threads (a shared Generator would race); seeded from an
+        # atomic counter so the root stream is reproducible run-to-run
+        import itertools
+
+        _root_seq = itertools.count()
+
         def batch_fn():
-            roots = graph.sample_node(batch_size, rng=np.random.default_rng())
+            root_rng = np.random.default_rng(
+                np.random.SeedSequence([17, next(_root_seq)])
+            )
+            roots = graph.sample_node(batch_size, rng=root_rng)
             return (flow.query(roots),)
 
     value, _ = _measure_training(

@@ -1,0 +1,38 @@
+"""graftlint — AST-based static analysis for this codebase's hazard classes.
+
+Four checkers walk the package's own AST (stdlib `ast` only — importing
+this package never imports jax/numpy, so the lint gate costs parse time,
+not framework import time):
+
+  jit-purity      — host-side control flow / numpy calls / host syncs on
+                    traced values inside jitted (or shard_mapped) code,
+                    and hazardous static_argnums declarations
+  lock-discipline — mutable state written both under and outside its
+                    lock, and unlocked check-then-act lazy init reachable
+                    from thread/worker-pool targets
+  wire-protocol   — client-sent verbs vs server-dispatched verbs vs the
+                    declared verb tables, per protocol domain
+  determinism     — unseeded np.random/random use outside the rng=None
+                    fallback idiom, set iteration feeding ordered output,
+                    jax.random key reuse
+
+Entry points: ``python -m euler_tpu.tools.lint`` (CLI) and
+``tests/test_lint.py`` (the tier-1 gate). See LINT.md for the suppression
+comment format and baseline workflow.
+"""
+
+from euler_tpu.analysis.core import (  # noqa: F401
+    CHECKERS,
+    Finding,
+    Module,
+    Project,
+    Report,
+    default_baseline_path,
+    load_baseline,
+    load_project,
+    register,
+    run,
+)
+
+# importing the checkers package populates the CHECKERS registry
+from euler_tpu.analysis import checkers as _checkers  # noqa: E402,F401

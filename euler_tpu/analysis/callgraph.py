@@ -1,0 +1,120 @@
+"""Module-local call graph + thread-entry reachability.
+
+Lock-discipline needs to know which functions can run on a thread that is
+NOT the constructing thread: anything referenced as a
+``threading.Thread(target=...)``, handed to an executor's ``submit``, or
+(transitively) called from one of those. Resolution is module-local and
+name-based:
+
+  self.m()   -> "<Class>.m"   (same class)
+  f()        -> "f"           (module-level def)
+  cls.m()    -> "<Class>.m"
+
+References count as edges even without a call — ``target=self._loop``
+and ``pool.submit(self._work)`` pass the function itself. Dynamic
+dispatch (``fn(*args)`` through a variable) is invisible, which is the
+right tradeoff: this feeds a heuristic race checker, and over-claiming
+reachability would drown real findings in noise.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.symbols import ModuleSymbols, dotted
+
+_SUBMIT_METHODS = {"submit", "map", "apply_async"}
+
+
+def _function_index(
+    tree: ast.Module,
+) -> dict[str, ast.FunctionDef]:
+    """qualname -> def node, for module-level functions and methods."""
+    out: dict[str, ast.FunctionDef] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[stmt.name] = stmt
+        elif isinstance(stmt, ast.ClassDef):
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[f"{stmt.name}.{sub.name}"] = sub
+    return out
+
+
+def _refs_in(fn: ast.FunctionDef, cls_name: str | None, index) -> set[str]:
+    refs: set[str] = set()
+    for node in ast.walk(fn):
+        d = dotted(node) if isinstance(node, (ast.Name, ast.Attribute)) else None
+        if not d:
+            continue
+        if cls_name and d.startswith("self."):
+            cand = f"{cls_name}.{d[len('self.'):]}"
+            if cand in index:
+                refs.add(cand)
+        elif d in index:
+            refs.add(d)
+        elif "." in d:
+            # Class.method spelled explicitly
+            if d in index:
+                refs.add(d)
+    return refs
+
+
+class CallGraph:
+    def __init__(self, tree: ast.Module, symbols: ModuleSymbols):
+        self.index = _function_index(tree)
+        self._cls_of = {}
+        for qual in self.index:
+            cls, _, _name = qual.rpartition(".")
+            self._cls_of[qual] = cls or None
+        self.edges: dict[str, set[str]] = {
+            qual: _refs_in(fn, self._cls_of[qual], self.index)
+            for qual, fn in self.index.items()
+        }
+        self.symbols = symbols
+        self.tree = tree
+
+    def thread_targets(self) -> set[str]:
+        """Qualnames referenced as Thread targets or executor submissions
+        anywhere in the module."""
+        out: set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = self.symbols.canonical_of(node.func) or ""
+            d = dotted(node.func) or ""
+            candidates: list[ast.AST] = []
+            if canon.endswith("threading.Thread") or canon == "threading.Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        candidates.append(kw.value)
+            elif d.rpartition(".")[2] in _SUBMIT_METHODS and node.args:
+                candidates.append(node.args[0])
+            for cand in candidates:
+                ref = dotted(cand)
+                if not ref:
+                    continue
+                if ref.startswith("self."):
+                    attr = ref[len("self."):]
+                    # attribute of whichever class encloses this call —
+                    # try every class (module-local, names rarely collide)
+                    for qual in self.index:
+                        if qual.endswith(f".{attr}"):
+                            out.add(qual)
+                elif ref in self.index:
+                    out.add(ref)
+        return out
+
+    def reachable(self, roots: set[str]) -> set[str]:
+        seen = set(roots)
+        stack = list(roots)
+        while stack:
+            cur = stack.pop()
+            for nxt in self.edges.get(cur, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return seen
+
+    def thread_reachable(self) -> set[str]:
+        return self.reachable(self.thread_targets())

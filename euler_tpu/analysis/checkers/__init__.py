@@ -1,0 +1,9 @@
+"""Checker registry population — importing this package registers all
+built-in checkers with euler_tpu.analysis.core.CHECKERS."""
+
+from euler_tpu.analysis.checkers import (  # noqa: F401
+    determinism,
+    jit_purity,
+    lock_discipline,
+    wire_protocol,
+)

@@ -1,0 +1,534 @@
+"""determinism: seed hygiene and order hygiene.
+
+Check ids:
+  det-unseeded-rng — ``np.random.default_rng()`` with no seed, legacy
+                     global-state numpy draws (``np.random.randint`` &
+                     co), or stdlib ``random.*`` draws. The rng=None
+                     API-fallback idiom is allowed — a caller passing
+                     rng=None explicitly chose nondeterminism:
+                         rng = rng if rng is not None else np.random.default_rng()
+                         if rng is None: rng = np.random.default_rng()
+                     Anything else (notably ``rng=np.random.default_rng()``
+                     at a CALL SITE, which silently discards the chance to
+                     seed) is flagged — the fused-plan A/B guarantee died
+                     exactly this way in review.
+  det-iter-order   — iterating a ``set``/``frozenset`` into an ordered
+                     sink (list/tuple/np.array/concatenate/join/
+                     json.dumps, or a loop that appends/yields).
+                     PYTHONHASHSEED makes str-keyed set order differ
+                     across processes, so anything serialized or fed to
+                     pytree construction from a set iteration is
+                     run-to-run nondeterministic. ``sorted(set(...))`` is
+                     the fix and passes clean.
+  det-key-reuse    — the same jax.random key consumed by two draws (same
+                     key → identical randomness; the classic copy-paste
+                     bug). Path-sensitive: branches that return don't leak
+                     consumption into the fallthrough path; a draw inside
+                     a loop from a key made outside it flags on the
+                     simulated second iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.core import Checker, Finding, Module, register
+from euler_tpu.analysis.symbols import assigned_names, dotted
+
+CHECKER = "determinism"
+
+_NP_LEGACY = {
+    "rand",
+    "randn",
+    "randint",
+    "random",
+    "random_sample",
+    "choice",
+    "shuffle",
+    "permutation",
+    "uniform",
+    "normal",
+    "binomial",
+    "poisson",
+    "seed",
+    "bytes",
+}
+_PY_RANDOM = {
+    "random",
+    "randint",
+    "randrange",
+    "choice",
+    "choices",
+    "shuffle",
+    "sample",
+    "uniform",
+    "gauss",
+    "seed",
+    "getrandbits",
+    "betavariate",
+    "expovariate",
+}
+# jax.random functions that do NOT consume a key's uniqueness
+_KEY_NONCONSUMING = {
+    "split",
+    "fold_in",
+    "PRNGKey",
+    "key",
+    "key_data",
+    "wrap_key_data",
+    "clone",
+}
+# commutative / order-insensitive consumers of an iterable
+_ORDER_SAFE_CALLS = {
+    "sum",
+    "max",
+    "min",
+    "any",
+    "all",
+    "len",
+    "set",
+    "frozenset",
+    "sorted",
+}
+_ORDERED_SINKS = {"list", "tuple"}
+
+
+# ---------------------------------------------------------------------------
+# det-unseeded-rng
+# ---------------------------------------------------------------------------
+
+
+def _is_rng_fallback(mod: Module, call: ast.Call, parents) -> bool:
+    """True when `call` (an unseeded default_rng()) sits in the rng=None
+    fallback idiom: the orelse of `X if X is not None else default_rng()`,
+    or the body of `if X is None: X = default_rng()` (incl. the
+    `X = X or default_rng()` BoolOp spelling)."""
+    p = parents.get(id(call))
+    if isinstance(p, ast.IfExp) and p.orelse is call:
+        t = p.test
+        if (
+            isinstance(t, ast.Compare)
+            and len(t.ops) == 1
+            and isinstance(t.ops[0], (ast.IsNot, ast.Is))
+            and isinstance(t.comparators[0], ast.Constant)
+            and t.comparators[0].value is None
+        ):
+            return True
+    if isinstance(p, ast.BoolOp) and isinstance(p.op, ast.Or):
+        return call in p.values[1:]
+    # statement form: if X is None: X = default_rng()
+    stmt = p
+    hops = 0
+    while stmt is not None and not isinstance(stmt, ast.stmt) and hops < 6:
+        stmt = parents.get(id(stmt))
+        hops += 1
+    if isinstance(stmt, ast.Assign):
+        enclosing = parents.get(id(stmt))
+        if isinstance(enclosing, ast.If):
+            t = enclosing.test
+            if (
+                isinstance(t, ast.Compare)
+                and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Is)
+                and isinstance(t.comparators[0], ast.Constant)
+                and t.comparators[0].value is None
+            ):
+                return True
+    return False
+
+
+def _check_unseeded(mod: Module, parents) -> list[Finding]:
+    out = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.symbols.canonical_of(node.func) or ""
+        qual = mod.qualname_of(node)
+        if canon == "numpy.random.default_rng":
+            if node.args or node.keywords:
+                continue  # seeded (or seeded via SeedSequence)
+            if _is_rng_fallback(mod, node, parents):
+                continue
+            out.append(
+                Finding(
+                    "det-unseeded-rng",
+                    CHECKER,
+                    mod.relpath,
+                    node.lineno,
+                    qual,
+                    "unseeded np.random.default_rng() outside the rng=None"
+                    " fallback idiom — pass/derive an explicit seed so the"
+                    " run is reproducible",
+                )
+            )
+        elif canon.startswith("numpy.random.") and (
+            canon.rpartition(".")[2] in _NP_LEGACY
+        ):
+            out.append(
+                Finding(
+                    "det-unseeded-rng",
+                    CHECKER,
+                    mod.relpath,
+                    node.lineno,
+                    qual,
+                    f"legacy global-state {canon}() — draws from the shared"
+                    " np.random stream; use an explicit"
+                    " np.random.default_rng(seed)",
+                )
+            )
+        elif canon.startswith("random.") and (
+            canon.rpartition(".")[2] in _PY_RANDOM
+        ):
+            out.append(
+                Finding(
+                    "det-unseeded-rng",
+                    CHECKER,
+                    mod.relpath,
+                    node.lineno,
+                    qual,
+                    f"stdlib {canon}() draws from the process-global stream"
+                    " — use a seeded random.Random(seed) or numpy Generator",
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# det-iter-order
+# ---------------------------------------------------------------------------
+
+
+def _set_names(fn_or_mod, mod: Module) -> set[str]:
+    """Names bound to set literals / set() / frozenset() / SetComp within
+    the given scope (flow-insensitive)."""
+    names: set[str] = set()
+    for node in ast.walk(fn_or_mod):
+        if isinstance(node, ast.Assign):
+            v = node.value
+            is_set = isinstance(v, (ast.Set, ast.SetComp)) or (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Name)
+                and v.func.id in ("set", "frozenset")
+            )
+            if is_set:
+                for t in node.targets:
+                    names.update(assigned_names(t))
+    return names
+
+
+def _is_set_expr(node: ast.AST, set_names: set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.Name):
+        return node.id in set_names
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+    ):
+        # set algebra: a | b, seen - done ...
+        return _is_set_expr(node.left, set_names) and _is_set_expr(
+            node.right, set_names
+        )
+    return False
+
+
+def _check_iter_order(mod: Module, parents) -> list[Finding]:
+    out: list[Finding] = []
+
+    def flag(line, qual, detail):
+        out.append(
+            Finding(
+                "det-iter-order",
+                CHECKER,
+                mod.relpath,
+                line,
+                qual,
+                f"{detail} — set order varies across processes"
+                " (PYTHONHASHSEED); sort first (sorted(...)) or keep an"
+                " ordered container",
+            )
+        )
+
+    scopes = [mod.tree] + [
+        n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    seen_lines: set[tuple[int, str]] = set()
+    for scope in scopes:
+        set_names = _set_names(scope, mod)
+        for node in ast.walk(scope):
+            # list(set_expr) / tuple(set_expr) / np.array(set-ish)
+            if isinstance(node, ast.Call):
+                fname = dotted(node.func) or ""
+                tail = fname.rpartition(".")[2]
+                if (
+                    (tail in _ORDERED_SINKS and isinstance(node.func, ast.Name))
+                    or (mod.symbols.canonical(fname) or "").startswith(
+                        ("numpy.array", "numpy.asarray", "numpy.fromiter")
+                    )
+                    or (mod.symbols.canonical(fname) or "")
+                    in ("json.dumps",)
+                ):
+                    if node.args and _is_set_expr(node.args[0], set_names):
+                        key = (node.lineno, "call")
+                        if key not in seen_lines:
+                            seen_lines.add(key)
+                            flag(
+                                node.lineno,
+                                mod.qualname_of(node),
+                                f"{tail}() over a set",
+                            )
+            # comprehension over a set feeding an ordered collection
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+                if not any(
+                    _is_set_expr(g.iter, set_names) for g in node.generators
+                ):
+                    continue
+                parent = parents.get(id(node))
+                if isinstance(parent, ast.Call):
+                    pf = dotted(parent.func) or ""
+                    if pf.rpartition(".")[2] in _ORDER_SAFE_CALLS:
+                        continue
+                key = (node.lineno, "comp")
+                if key not in seen_lines:
+                    seen_lines.add(key)
+                    flag(
+                        node.lineno,
+                        mod.qualname_of(node),
+                        "comprehension over a set builds an ordered result",
+                    )
+            # for-loop over a set whose body appends/yields
+            elif isinstance(node, ast.For):
+                if not _is_set_expr(node.iter, set_names):
+                    continue
+                ordered_body = False
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.Yield, ast.YieldFrom)):
+                        ordered_body = True
+                    elif isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Attribute
+                    ):
+                        if sub.func.attr in ("append", "extend", "insert"):
+                            ordered_body = True
+                if ordered_body:
+                    key = (node.lineno, "for")
+                    if key not in seen_lines:
+                        seen_lines.add(key)
+                        flag(
+                            node.lineno,
+                            mod.qualname_of(node),
+                            "for-loop over a set appends to an ordered"
+                            " collection",
+                        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# det-key-reuse
+# ---------------------------------------------------------------------------
+
+
+def _is_key_producer(mod: Module, value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    canon = mod.symbols.canonical_of(value.func) or ""
+    return canon in (
+        "jax.random.PRNGKey",
+        "jax.random.key",
+        "jax.random.split",
+        "jax.random.fold_in",
+        "jax.random.clone",
+    )
+
+
+class _KeyState:
+    """Per-path map: key name -> line of its (single allowed) consumption,
+    or None if unconsumed."""
+
+    def __init__(self, inner=None):
+        self.consumed: dict[str, int] = dict(inner or {})
+
+    def copy(self):
+        return _KeyState(self.consumed)
+
+
+def _scan_key_reuse(mod: Module, fn) -> list[Finding]:
+    findings: list[Finding] = []
+    reported: set[tuple[str, int]] = set()
+    qual = mod.qualname_of(fn)
+    qual = f"{qual}.{fn.name}" if qual != "<module>" else fn.name
+
+    def consume(name: str, line: int, state: _KeyState):
+        prev = state.consumed.get(name)
+        # prev > line is an artifact of the loop's second simulated pass
+        # (the "first" consumption seen again) — the real reuse site was
+        # already reported on pass one
+        if prev is not None and prev <= line and (name, line) not in reported:
+            reported.add((name, line))
+            where = (
+                f"already consumed at line {prev}"
+                if prev != line
+                else "consumed again on the next loop iteration"
+            )
+            findings.append(
+                Finding(
+                    "det-key-reuse",
+                    CHECKER,
+                    mod.relpath,
+                    line,
+                    qual,
+                    f"jax.random key `{name}` {where} — reusing a key"
+                    " repeats the same randomness; split it"
+                    " (`k1, k2 = jax.random.split(key)`) or fold_in a"
+                    " counter",
+                )
+            )
+        state.consumed[name] = line
+
+    def scan_expr(node: ast.AST, state: _KeyState, loop_pass: bool):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            canon = mod.symbols.canonical_of(sub.func) or ""
+            if not canon.startswith("jax.random."):
+                continue
+            fname = canon[len("jax.random."):]
+            if fname in _KEY_NONCONSUMING:
+                continue
+            for a in sub.args[:1]:  # key is the first positional arg
+                if isinstance(a, ast.Name) and a.id in tracked:
+                    consume(a.id, sub.lineno, state)
+            for kw in sub.keywords:
+                if kw.arg == "key" and isinstance(kw.value, ast.Name):
+                    if kw.value.id in tracked:
+                        consume(kw.value.id, sub.lineno, state)
+
+    tracked: set[str] = set()
+
+    def scan_block(stmts, state: _KeyState, loop_pass=False) -> bool:
+        """Returns True when the block terminates (return/raise)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs get their own scan
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                if value is not None:
+                    scan_expr(value, state, loop_pass)
+                if value is not None and _is_key_producer(mod, value):
+                    for t in targets:
+                        for n in assigned_names(t):
+                            tracked.add(n)
+                            state.consumed[n] = None
+                    # re-deriving FROM a name refreshes it too
+                else:
+                    for t in targets:
+                        for n in assigned_names(t):
+                            if n in tracked:
+                                # rebound to a non-key value: stop tracking
+                                state.consumed.pop(n, None)
+                                tracked.discard(n)
+            elif isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    scan_expr(stmt.value, state, loop_pass)
+                return True
+            elif isinstance(stmt, ast.Raise):
+                return True
+            elif isinstance(stmt, ast.If):
+                scan_expr(stmt.test, state, loop_pass)
+                b = state.copy()
+                o = state.copy()
+                bt = scan_block(stmt.body, b, loop_pass)
+                ot = scan_block(stmt.orelse, o, loop_pass)
+                if bt and ot:
+                    return True
+                if bt:
+                    state.consumed = o.consumed
+                elif ot:
+                    state.consumed = b.consumed
+                else:
+                    # merge: consumed only if consumed on BOTH paths
+                    merged = {}
+                    for k in set(b.consumed) | set(o.consumed):
+                        vb, vo = b.consumed.get(k), o.consumed.get(k)
+                        merged[k] = (
+                            vb if (vb is not None and vo is not None) else None
+                        )
+                    state.consumed = merged
+            elif isinstance(stmt, (ast.For, ast.While)):
+                if isinstance(stmt, ast.For):
+                    scan_expr(stmt.iter, state, loop_pass)
+                else:
+                    scan_expr(stmt.test, state, loop_pass)
+                # pass 1 records consumptions; pass 2 reports reuse of keys
+                # that were NOT refreshed inside the body
+                body_state = state.copy()
+                scan_block(stmt.body, body_state, loop_pass)
+                scan_block(stmt.body, body_state, True)
+                state.consumed.update(body_state.consumed)
+                scan_block(stmt.orelse, state, loop_pass)
+            elif isinstance(stmt, (ast.With,)):
+                for item in stmt.items:
+                    scan_expr(item.context_expr, state, loop_pass)
+                if scan_block(stmt.body, state, loop_pass):
+                    return True
+            elif isinstance(stmt, ast.Try):
+                scan_block(stmt.body, state.copy(), loop_pass)
+                for h in stmt.handlers:
+                    scan_block(h.body, state.copy(), loop_pass)
+                scan_block(stmt.orelse, state, loop_pass)
+                scan_block(stmt.finalbody, state, loop_pass)
+            elif isinstance(stmt, ast.Expr):
+                scan_expr(stmt.value, state, loop_pass)
+            elif isinstance(stmt, ast.AugAssign):
+                scan_expr(stmt.value, state, loop_pass)
+        return False
+
+    # params named like keys are tracked too (callers hand a fresh key in)
+    for p in fn.args.args + fn.args.kwonlyargs + fn.args.posonlyargs:
+        if p.arg == "key" or p.arg.endswith("_key") or p.arg == "rng_key":
+            tracked.add(p.arg)
+
+    state = _KeyState({n: None for n in tracked})
+    scan_block(fn.body, state)
+    return findings
+
+
+def _check_key_reuse(mod: Module) -> list[Finding]:
+    out = []
+    for fn in ast.walk(mod.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.extend(_scan_key_reuse(mod, fn))
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
+def _parent_map(mod: Module) -> dict[int, ast.AST]:
+    parents: dict[int, ast.AST] = {}
+    for parent in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+    return parents
+
+
+@register
+class DeterminismChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            parents = _parent_map(mod)
+            out.extend(_check_unseeded(mod, parents))
+            out.extend(_check_iter_order(mod, parents))
+            out.extend(_check_key_reuse(mod))
+        return out

@@ -1,0 +1,436 @@
+"""lock-discipline: shared mutable state vs the locks that guard it.
+
+Check ids:
+  lock-mixed-write — a class attribute (``self.x``) or module global that
+                     is written under a lock somewhere and written WITHOUT
+                     that lock somewhere else (``__init__``/module top
+                     level exempt: construction happens-before sharing)
+  lock-racy-init   — unlocked check-then-act lazy initialization
+                     (``if self.x is None: self.x = ...``,
+                     ``if not hasattr(o, 'x'): o.x = ...``,
+                     ``if k not in cache: cache[k] = ...``) in a function
+                     reachable from a ``threading.Thread`` / worker-pool
+                     target, or on a class that owns locks (a class that
+                     declares a lock declares itself concurrent) — the
+                     pre-PR-2 ``_jit_cache`` attribute-injection race
+
+Lock identity is syntactic: ``with self._lock:`` guards writes spelled
+under it; the guarded-state inference is "other writes of the same name
+hold lock L" — exactly how a reviewer reads the code. Condition objects
+count as locks (``with self._cond:`` acquires). Attributes of
+``threading.local()`` objects are thread-confined and never flagged.
+
+Writes tracked: assignment / augmented assignment to ``self.x`` or a
+declared-global name, subscript stores ``x[k] = v``, and mutating method
+calls (append/add/update/pop/...) on tracked names.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.callgraph import CallGraph
+from euler_tpu.analysis.core import Checker, Finding, Module, register
+from euler_tpu.analysis.symbols import LOCK_TYPES, dotted
+
+CHECKER = "lock-discipline"
+
+_INIT_FUNCS = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "update",
+    "pop",
+    "popitem",
+    "remove",
+    "discard",
+    "clear",
+    "setdefault",
+    "appendleft",
+    "popleft",
+}
+
+
+class _Write:
+    __slots__ = ("qual", "line", "locks", "init", "kind")
+
+    def __init__(self, qual, line, locks, init, kind):
+        self.qual = qual
+        self.line = line
+        self.locks = frozenset(locks)
+        self.init = init
+        self.kind = kind  # "assign" | "mutate"
+
+
+def _lock_expr(mod: Module, node: ast.AST, cls: str | None) -> str | None:
+    """The lock identity a `with` item acquires, or None.
+
+    self._lock -> "<cls>.self._lock" when the class binds a Lock to that
+    attr; module-global LOCK names resolve through the ctor map."""
+    d = dotted(node)
+    if d is None:
+        # with self._lock: is the common case; lock.acquire() style or
+        # contextlib wrappers are out of scope
+        return None
+    if d.startswith("self.") and cls is not None:
+        attr = d[len("self."):]
+        if mod.symbols.class_self_ctors_cached(cls).get(attr) in LOCK_TYPES:
+            return f"{cls}.{d}"
+        return None
+    if mod.symbols.global_ctors.get(d) in LOCK_TYPES:
+        return d
+    return None
+
+
+# class_self_ctors is O(class body) — memoize per module+class
+def _ensure_ctor_cache(symbols):
+    if not hasattr(symbols, "_ctor_cache"):
+        symbols._ctor_cache = {}
+
+        def cached(cls_name):
+            if cls_name not in symbols._ctor_cache:
+                cls = symbols.classes.get(cls_name)
+                symbols._ctor_cache[cls_name] = (
+                    symbols.class_self_ctors(cls) if cls is not None else {}
+                )
+            return symbols._ctor_cache[cls_name]
+
+        symbols.class_self_ctors_cached = cached
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Collect writes + lazy-init patterns for one function body."""
+
+    def __init__(self, mod, cls, qual, declared_globals):
+        self.mod = mod
+        self.cls = cls
+        self.qual = qual
+        self.declared_globals = declared_globals
+        self.locks: list[str] = []
+        self.writes: dict[str, list[_Write]] = {}
+        self.lazy_inits: list[tuple[str, int, str]] = []  # key, line, detail
+        self.tls = mod.symbols.thread_local_names()
+        self.init = qual.rpartition(".")[2] in _INIT_FUNCS
+
+    # -- state key resolution -------------------------------------------
+
+    def _key(self, target: ast.AST) -> str | None:
+        """Tracking key for a write target: "<Cls>.self.x" for self attrs,
+        the bare name for module globals. None = not shared state."""
+        d = dotted(target)
+        if d is None:
+            return None
+        if d.startswith("self.") and d.count(".") == 1 and self.cls:
+            if d in self.tls or d[len("self."):] in self.tls:
+                return None
+            ctor = self.mod.symbols.class_self_ctors_cached(self.cls).get(
+                d[len("self."):]
+            )
+            if ctor in LOCK_TYPES:
+                return None  # rebinding a lock is its own sin, not this one
+            return f"{self.cls}.{d}"
+        if "." not in d:
+            if d in self.tls:
+                return None
+            # bare name: shared only if a declared global or a known
+            # module-level binding being MUTATED (not rebound locally)
+            if d in self.declared_globals:
+                return d
+            return None
+        # dotted module-global mutation like _CACHES[k] via attr? handled
+        # by subscript/mutator paths passing the base expression
+        base = d.split(".")[0]
+        if base in self.tls or d in self.tls:
+            return None
+        return None
+
+    def _mutation_key(self, base: ast.AST) -> str | None:
+        """Key for mutations THROUGH a name (x[k]=v, x.append(...)):
+        module-level names count without a `global` declaration (mutation
+        doesn't rebind), self attrs as usual."""
+        d = dotted(base)
+        if d is None:
+            return None
+        if d in self.tls:
+            return None
+        if d.startswith("self.") and d.count(".") == 1 and self.cls:
+            if d[len("self."):] in self.tls:
+                return None
+            return f"{self.cls}.{d}"
+        if "." not in d and (
+            d in self.mod.symbols.global_ctors
+            or d in self.declared_globals
+            or d in self._module_level_names()
+        ):
+            return d
+        return None
+
+    def _module_level_names(self):
+        if not hasattr(self.mod, "_toplevel_names"):
+            names = set()
+            for stmt in self.mod.tree.body:
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+            self.mod._toplevel_names = names
+        return self.mod._toplevel_names
+
+    def _record(self, key: str | None, line: int, kind: str):
+        if key is None:
+            return
+        self.writes.setdefault(key, []).append(
+            _Write(self.qual, line, self.locks, self.init, kind)
+        )
+
+    # -- visitors ---------------------------------------------------------
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            lk = _lock_expr(self.mod, item.context_expr, self.cls)
+            if lk is not None:
+                acquired.append(lk)
+        self.locks.extend(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.locks.pop()
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._record(
+                    self._mutation_key(t.value), node.lineno, "mutate"
+                )
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    if isinstance(e, ast.Subscript):
+                        self._record(
+                            self._mutation_key(e.value), node.lineno, "mutate"
+                        )
+                    else:
+                        self._record(self._key(e), node.lineno, "assign")
+            else:
+                self._record(self._key(t), node.lineno, "assign")
+        self.generic_visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if isinstance(node.target, ast.Subscript):
+            self._record(
+                self._mutation_key(node.target.value), node.lineno, "mutate"
+            )
+        else:
+            self._record(self._key(node.target), node.lineno, "assign")
+        self.generic_visit(node.value)
+
+    def visit_Call(self, node: ast.Call):
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+        ):
+            self._record(
+                self._mutation_key(node.func.value), node.lineno, "mutate"
+            )
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If):
+        detail = self._lazy_init_pattern(node)
+        if detail is not None and not self.locks:
+            key, msg = detail
+            self.lazy_inits.append((key, node.lineno, msg))
+        self.generic_visit(node)
+
+    # nested defs: scanned separately with their own qualname
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    # -- lazy-init pattern ------------------------------------------------
+
+    def _lazy_init_pattern(self, node: ast.If):
+        """(state key, message) when `node` is an unlocked check-then-act
+        lazy init; None otherwise."""
+        test = node.test
+        guard_target: str | None = None
+        how = ""
+        if (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Is)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+        ):
+            guard_target = dotted(test.left)
+            how = f"`{guard_target} is None`"
+        elif (
+            isinstance(test, ast.UnaryOp)
+            and isinstance(test.op, ast.Not)
+            and isinstance(test.operand, ast.Call)
+            and isinstance(test.operand.func, ast.Name)
+            and test.operand.func.id == "hasattr"
+            and len(test.operand.args) == 2
+            and isinstance(test.operand.args[1], ast.Constant)
+        ):
+            obj = dotted(test.operand.args[0])
+            attr = test.operand.args[1].value
+            if obj:
+                guard_target = f"{obj}.{attr}"
+                how = f"`not hasattr({obj}, {attr!r})`"
+        elif (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.NotIn)
+        ):
+            container = dotted(test.comparators[0])
+            if container:
+                guard_target = container
+                how = f"`... not in {container}`"
+        if guard_target is None or guard_target.split(".")[0] in self.tls:
+            return None
+        if guard_target in self.tls:
+            return None
+        # does the body write the guarded target?
+        for stmt in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    td = dotted(t)
+                    if td == guard_target:
+                        return guard_target, how
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and dotted(t.value) == guard_target
+                    ):
+                        return guard_target, how
+            elif isinstance(stmt, ast.With):
+                # double-checked WITH a lock inside: not racy
+                for item in stmt.items:
+                    if _lock_expr(self.mod, item.context_expr, self.cls):
+                        return None
+        return None
+
+
+def _scan_module(mod: Module) -> list[Finding]:
+    _ensure_ctor_cache(mod.symbols)
+    cg = CallGraph(mod.tree, mod.symbols)
+    thread_reach = cg.thread_reachable()
+
+    all_writes: dict[str, list[_Write]] = {}
+    lazy: list[tuple[str, str, int, str]] = []  # qual, key, line, how
+
+    # per-function declared globals
+    def declared_globals(fn):
+        out = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Global):
+                out.update(n.names)
+        return out
+
+    def scan_fn(fn, cls_name, qual):
+        sc = _FunctionScanner(mod, cls_name, qual, declared_globals(fn))
+        for stmt in fn.body:
+            sc.visit(stmt)
+        for key, ws in sc.writes.items():
+            all_writes.setdefault(key, []).extend(ws)
+        for key, line, how in sc.lazy_inits:
+            lazy.append((qual, key, line, how))
+
+    def walk_defs(body, cls_name, prefix):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{stmt.name}"
+                scan_fn(stmt, cls_name, qual)
+                walk_defs(stmt.body, cls_name, f"{qual}.")
+            elif isinstance(stmt, ast.ClassDef):
+                walk_defs(stmt.body, stmt.name, f"{stmt.name}.")
+
+    walk_defs(mod.tree.body, None, "")
+
+    findings: list[Finding] = []
+
+    # -- lock-mixed-write -------------------------------------------------
+    for key, writes in sorted(all_writes.items()):
+        locked = [w for w in writes if w.locks and not w.init]
+        if not locked:
+            continue
+        guard_locks = set().union(*(w.locks for w in locked))
+        for w in writes:
+            if w.init or w.locks & guard_locks:
+                continue
+            others = sorted(
+                {f"{x.qual} (line {x.line})" for x in locked}
+            )
+            lock_names = ", ".join(sorted(guard_locks))
+            findings.append(
+                Finding(
+                    "lock-mixed-write",
+                    CHECKER,
+                    mod.relpath,
+                    w.line,
+                    w.qual,
+                    f"{key.split('.', 1)[-1] if key.startswith(w.qual.split('.')[0]) else key}"
+                    f" written here without {lock_names}, but written under"
+                    f" it in {'; '.join(others)} — either every writer"
+                    " holds the lock or none does",
+                )
+            )
+
+    # -- lock-racy-init ---------------------------------------------------
+    class_has_lock = {
+        cls_name: any(
+            c in LOCK_TYPES
+            for c in mod.symbols.class_self_ctors_cached(cls_name).values()
+        )
+        for cls_name in mod.symbols.classes
+    }
+    for qual, key, line, how in lazy:
+        cls_name = qual.split(".")[0] if "." in qual else None
+        concurrent_cls = bool(cls_name and class_has_lock.get(cls_name))
+        if qual not in thread_reach and not concurrent_cls:
+            continue
+        why = (
+            f"reachable from a thread/worker-pool target"
+            if qual in thread_reach
+            else f"class {cls_name} owns a lock (declares itself concurrent)"
+        )
+        findings.append(
+            Finding(
+                "lock-racy-init",
+                CHECKER,
+                mod.relpath,
+                line,
+                qual,
+                f"unlocked check-then-act lazy init of `{key}` ({how}) —"
+                f" {why}; two threads can both see it missing and both"
+                " build (the pre-PR-2 _jit_cache race). Guard the"
+                " get-or-build with a lock (double-checked is fine)",
+            )
+        )
+    return findings
+
+
+@register
+class LockDisciplineChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            out.extend(_scan_module(mod))
+        return out

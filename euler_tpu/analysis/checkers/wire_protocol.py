@@ -1,0 +1,321 @@
+"""wire-protocol: client-sent verbs vs server-dispatched verbs vs the
+declared verb tables, per protocol domain.
+
+Check ids:
+  wire-unhandled   — a client puts a verb on the wire that no server in
+                     its domain dispatches (runtime: RpcError "unknown op"
+                     on the first call — exactly the bug class the
+                     exec_plan/stats/predict/server_stats verbs of PRs 1-2
+                     could have shipped)
+  wire-unreachable — a server dispatches a verb no client in its domain
+                     ever sends (dead protocol surface, or a client-side
+                     send that was renamed without the server)
+  wire-table-drift — a declared verb table (RemoteShard.WIRE_VERBS,
+                     service.HANDLED_VERBS, ...) disagrees with what the
+                     AST actually sends/handles; the tables are
+                     load-bearing (dispatch gates on them, the runtime
+                     parity test in tests/test_wire_parity.py instantiates
+                     them), so drift means the gate and the code diverged
+
+Extraction (AST, not grep):
+  sent    — ``<obj>.call("verb", ...)`` / ``<obj>.submit("verb", ...)`` /
+            ``self._call("verb", ...)`` with a literal first arg, plus
+            ``return "verb", [...]`` in ``*_req`` helper functions (the
+            request-builder idiom)
+  handled — ``op == "verb"`` comparisons (and ``op in (...)`` membership)
+            inside any function with an ``op`` parameter in a server
+            module, plus string tuples assigned to ``*_OPS`` class attrs
+  tables  — module/class assignments of names ending in WIRE_VERBS /
+            HANDLED_VERBS whose value is a set/frozenset/tuple of strings
+
+Domains are configurable (fixtures pass their own); the defaults cover
+the two protocols this repo speaks.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from euler_tpu.analysis.core import Checker, Finding, Module, Project, register
+from euler_tpu.analysis.symbols import dotted
+
+CHECKER = "wire-protocol"
+
+_SEND_METHODS = {"call", "submit", "_call"}
+# statuses ride the same frames but are not verbs
+_STATUSES = {"ok", "err"}
+
+
+@dataclass
+class WireDomain:
+    name: str
+    clients: tuple  # relpaths of modules that put verbs on the wire
+    servers: tuple  # relpaths of modules that dispatch verbs
+    # verbs intentionally one-sided (e.g. kept for old peers) — empty now,
+    # here so a future deprecation has a home other than the baseline
+    allow_unsent: tuple = ()
+    allow_unhandled: tuple = ()
+
+
+DEFAULT_DOMAINS = (
+    WireDomain(
+        name="graph",
+        clients=(
+            "euler_tpu/distributed/client.py",
+            "euler_tpu/query/plan.py",
+        ),
+        servers=("euler_tpu/distributed/service.py",),
+    ),
+    WireDomain(
+        name="serving",
+        clients=("euler_tpu/serving/client.py",),
+        servers=("euler_tpu/serving/server.py",),
+    ),
+)
+
+
+@dataclass
+class VerbSites:
+    # verb -> first (line, qualname) observed
+    sites: dict = field(default_factory=dict)
+
+    def add(self, verb: str, line: int, qual: str):
+        self.sites.setdefault(verb, (line, qual))
+
+    def verbs(self) -> set:
+        return set(self.sites)
+
+
+def extract_sent(mod: Module) -> VerbSites:
+    out = VerbSites()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _SEND_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                verb = node.args[0].value
+                if verb not in _STATUSES:
+                    out.add(verb, node.lineno, mod.qualname_of(node))
+        elif isinstance(node, ast.Return) and isinstance(
+            node.value, ast.Tuple
+        ):
+            # request-builder idiom: `return "verb", [args...]`
+            qual = mod.qualname_of(node)
+            if not qual.rpartition(".")[2].endswith("_req"):
+                continue
+            elts = node.value.elts
+            if (
+                len(elts) == 2
+                and isinstance(elts[0], ast.Constant)
+                and isinstance(elts[0].value, str)
+                and isinstance(elts[1], (ast.List, ast.Tuple))
+            ):
+                out.add(elts[0].value, node.lineno, qual)
+    return out
+
+
+def extract_handled(mod: Module) -> VerbSites:
+    out = VerbSites()
+    # string-tuple class attrs like COORDINATOR_OPS feed `op in self.X`
+    ops_attrs: dict[str, list[str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                name = t.id if isinstance(t, ast.Name) else None
+                if name and name.endswith("_OPS"):
+                    vals = _str_elements(node.value)
+                    if vals is not None:
+                        ops_attrs[name] = vals
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = {a.arg for a in fn.args.args}
+        if "op" not in params:
+            continue
+        qual = mod.qualname_of(fn)
+        qual = f"{qual}.{fn.name}" if qual != "<module>" else fn.name
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+                continue
+            if not (
+                isinstance(node.left, ast.Name) and node.left.id == "op"
+            ):
+                continue
+            if isinstance(node.ops[0], (ast.Eq,)):
+                c = node.comparators[0]
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    if c.value not in _STATUSES:
+                        out.add(c.value, node.lineno, qual)
+            elif isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                c = node.comparators[0]
+                vals = _str_elements(c)
+                if vals is None:
+                    d = dotted(c) or ""
+                    attr = d.rpartition(".")[2]
+                    vals = ops_attrs.get(attr)
+                for v in vals or ():
+                    out.add(v, node.lineno, qual)
+    return out
+
+
+def _str_elements(node: ast.AST) -> list[str] | None:
+    """Literal list of strings from a tuple/list/set/frozenset(...) node."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("frozenset", "set", "tuple") and node.args:
+            return _str_elements(node.args[0])
+        return None
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        vals = []
+        for e in node.elts:
+            if not (
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ):
+                return None
+            vals.append(e.value)
+        return vals
+    return None
+
+
+def extract_tables(mod: Module) -> dict[str, tuple[list[str], int]]:
+    """declared table name (qualified by class when nested) ->
+    (verbs, line). Tables are names ending in WIRE_VERBS or HANDLED_VERBS."""
+    out: dict[str, tuple[list[str], int]] = {}
+
+    def scan(body, prefix):
+        for stmt in body:
+            if isinstance(stmt, ast.ClassDef):
+                scan(stmt.body, f"{stmt.name}.")
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if t.id.endswith(("WIRE_VERBS", "HANDLED_VERBS")):
+                        vals = _str_elements(stmt.value)
+                        if vals is not None:
+                            out[f"{prefix}{t.id}"] = (vals, stmt.lineno)
+
+    scan(mod.tree.body, "")
+    return out
+
+
+def check_domain(project: Project, domain: WireDomain) -> list[Finding]:
+    findings: list[Finding] = []
+    sent: dict[str, tuple[str, int, str]] = {}  # verb -> (path, line, qual)
+    handled: dict[str, tuple[str, int, str]] = {}
+    client_tables: dict[str, tuple[str, list[str], int]] = {}
+    server_tables: dict[str, tuple[str, list[str], int]] = {}
+
+    def mods(paths):
+        for p in paths:
+            m = project.module(p)
+            if m is not None:
+                yield m
+
+    client_mods = list(mods(domain.clients))
+    server_mods = list(mods(domain.servers))
+    if not client_mods or not server_mods:
+        return []  # domain not in this project slice — nothing to check
+
+    for m in client_mods:
+        for verb, (line, qual) in extract_sent(m).sites.items():
+            sent.setdefault(verb, (m.relpath, line, qual))
+        for name, (vals, line) in extract_tables(m).items():
+            client_tables[name] = (m.relpath, vals, line)
+    for m in server_mods:
+        for verb, (line, qual) in extract_handled(m).sites.items():
+            handled.setdefault(verb, (m.relpath, line, qual))
+        for name, (vals, line) in extract_tables(m).items():
+            server_tables[name] = (m.relpath, vals, line)
+
+    for verb in sorted(set(sent) - set(handled)):
+        if verb in domain.allow_unhandled:
+            continue
+        path, line, qual = sent[verb]
+        findings.append(
+            Finding(
+                "wire-unhandled",
+                CHECKER,
+                path,
+                line,
+                qual,
+                f"[{domain.name}] client sends verb {verb!r} but no server in"
+                f" ({', '.join(domain.servers)}) dispatches it — first call"
+                " will fail with unknown-op",
+            )
+        )
+    for verb in sorted(set(handled) - set(sent)):
+        if verb in domain.allow_unsent:
+            continue
+        path, line, qual = handled[verb]
+        findings.append(
+            Finding(
+                "wire-unreachable",
+                CHECKER,
+                path,
+                line,
+                qual,
+                f"[{domain.name}] server dispatches verb {verb!r} but no"
+                f" client in ({', '.join(domain.clients)}) sends it — dead"
+                " surface or a renamed client send",
+            )
+        )
+
+    # declared tables must (as a union per side — one protocol's client
+    # surface may span modules, e.g. RemoteShard + the planner) equal the
+    # AST-observed truth for that side
+    _union_drift(findings, domain, client_tables, set(sent), "sends")
+    _union_drift(findings, domain, server_tables, set(handled), "handles")
+    return findings
+
+
+def _union_drift(findings, domain, tables, truth, what):
+    if not tables:
+        return
+    declared = set()
+    for _, (path, vals, line) in tables.items():
+        declared |= set(vals)
+    missing = sorted(truth - declared)
+    extra = sorted(declared - truth)
+    if not missing and not extra:
+        return
+    parts = []
+    if missing:
+        parts.append(f"missing {missing}")
+    if extra:
+        parts.append(f"lists unsent/unhandled {extra}")
+    anchor_name = sorted(tables)[0]
+    path, _, line = (
+        tables[anchor_name][0],
+        tables[anchor_name][1],
+        tables[anchor_name][2],
+    )
+    findings.append(
+        Finding(
+            "wire-table-drift",
+            CHECKER,
+            path,
+            line,
+            anchor_name,
+            f"[{domain.name}] declared verb tables"
+            f" ({', '.join(sorted(tables))}) disagree with what the domain"
+            f" actually {what}: {'; '.join(parts)}",
+        )
+    )
+
+
+@register
+class WireProtocolChecker(Checker):
+    name = CHECKER
+    domains = DEFAULT_DOMAINS
+
+    def check(self, project) -> list[Finding]:
+        out: list[Finding] = []
+        for domain in self.domains:
+            out.extend(check_domain(project, domain))
+        return out

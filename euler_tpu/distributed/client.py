@@ -141,6 +141,48 @@ class RemoteShard:
     RETRIES = 10
     QUARANTINE_S = 5.0
 
+    # Every graph-protocol verb this client can put on the wire. The
+    # table is load-bearing: graftlint's wire-protocol checker diffs it
+    # against the verbs the methods below actually send AND against the
+    # server's HANDLED_VERBS gate, and tests/test_wire_parity.py asserts
+    # the same parity at runtime — adding a verb on one side without the
+    # other fails the tier-1 gate, not the first production call.
+    WIRE_VERBS = frozenset({
+        "condition_mask",
+        "condition_weight",
+        "degree_sum",
+        "dense_feature_udf",
+        "get_binary_feature",
+        "get_dense_by_rows",
+        "get_dense_feature",
+        "get_edge_binary_feature",
+        "get_edge_dense_feature",
+        "get_edge_sparse_feature",
+        "get_full_neighbor",
+        "get_graph_by_label",
+        "get_meta",
+        "get_sparse_feature",
+        "get_top_k_neighbor",
+        "lookup",
+        "node2vec_step",
+        "node_ids_by_condition",
+        "node_type",
+        "num_nodes",
+        "ping",
+        "random_walk",
+        "sage_minibatch",
+        "sample_edge",
+        "sample_edge_with_condition",
+        "sample_fanout",
+        "sample_nb_rows",
+        "sample_neighbor",
+        "sample_neighbor_layerwise",
+        "sample_node",
+        "sample_node_with_condition",
+        "stats",
+        "unit_edge_weights",
+    })
+
     def __init__(self, shard: int, replicas: list[tuple[str, int]]):
         self.shard = shard
         self.replicas = [_Replica(h, p) for h, p in replicas]
@@ -178,9 +220,12 @@ class RemoteShard:
 
     def close(self):
         """Stop the in-flight executor workers (idempotent)."""
-        if self._pool is not None:
-            self._pool.close()
-            self._pool = None
+        # swap under the lock _executor builds under — close() racing a
+        # concurrent lazy build must not strand a half-built pool
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.close()
 
     @property
     def part(self) -> int:
@@ -191,7 +236,13 @@ class RemoteShard:
     @property
     def num_nodes(self) -> int:
         if self._num_nodes is None:
-            self._num_nodes = int(self.call("num_nodes", [])[0])
+            # RPC outside the lock (call() takes self._lock in _pick — a
+            # locked fetch would self-deadlock); publish under it so racing
+            # readers agree on one value
+            n = int(self.call("num_nodes", [])[0])
+            with self._lock:
+                if self._num_nodes is None:
+                    self._num_nodes = n
         return self._num_nodes
 
     def add_replica(self, host: str, port: int):
@@ -228,6 +279,17 @@ class RemoteShard:
         )
 
     # -- GraphStore surface ---------------------------------------------
+
+    def ping(self) -> int:
+        """Liveness probe: the serving shard's index (health checks and
+        topology debugging — the client half of the server's `ping` verb)."""
+        return int(self.call("ping", [])[0])
+
+    def stats(self) -> dict:
+        """The server's per-op request counters (the wire twin of reading
+        GraphService.op_counts in-process — what the bench's RPC-count
+        lane and capacity dashboards poll)."""
+        return json.loads(self.call("stats", [])[0])
 
     def lookup(self, ids):
         return self.call("lookup", [np.asarray(ids, np.uint64)])[0]
@@ -267,9 +329,14 @@ class RemoteShard:
         # their cache entries distinct
         key = None if edge_types is None else tuple(_types(edge_types))
         if key not in self._unit_w:
-            self._unit_w[key] = bool(
+            # fetch outside the lock (call() → _pick takes self._lock),
+            # publish under it — concurrent misses fetch twice but can't
+            # corrupt the dict mid-resize
+            val = bool(
                 self.call("unit_edge_weights", [_types(edge_types)])[0]
             )
+            with self._lock:
+                self._unit_w.setdefault(key, val)
         return self._unit_w[key]
 
     def get_full_neighbor(

@@ -313,6 +313,47 @@ class GraphService:
 
     COORDINATOR_OPS = ("sample_fanout", "sage_minibatch", "exec_plan")
 
+    # Load-bearing verb table: dispatch() gates on it, graftlint's
+    # wire-protocol checker diffs it against the `op ==` chain below and
+    # against the clients' WIRE_VERBS, and tests/test_wire_parity.py
+    # asserts client/server parity at runtime.
+    HANDLED_VERBS = frozenset({
+        "condition_mask",
+        "condition_weight",
+        "degree_sum",
+        "dense_feature_udf",
+        "exec_plan",
+        "get_binary_feature",
+        "get_dense_by_rows",
+        "get_dense_feature",
+        "get_edge_binary_feature",
+        "get_edge_dense_feature",
+        "get_edge_sparse_feature",
+        "get_full_neighbor",
+        "get_graph_by_label",
+        "get_meta",
+        "get_sparse_feature",
+        "get_top_k_neighbor",
+        "lookup",
+        "node2vec_step",
+        "node_ids_by_condition",
+        "node_type",
+        "num_nodes",
+        "ping",
+        "random_walk",
+        "sage_minibatch",
+        "sample_edge",
+        "sample_edge_with_condition",
+        "sample_fanout",
+        "sample_nb_rows",
+        "sample_neighbor",
+        "sample_neighbor_layerwise",
+        "sample_node",
+        "sample_node_with_condition",
+        "stats",
+        "unit_edge_weights",
+    })
+
     def is_coordinator(self, op: str) -> bool:
         """True for ops that fan out to peer shards (blocking leaf RPCs);
         these must not consume main-pool workers or two mutually-dependent
@@ -320,6 +361,9 @@ class GraphService:
         return op in self.COORDINATOR_OPS and self.meta.num_partitions > 1
 
     def dispatch(self, op: str, a: list) -> list:
+        if op not in self.HANDLED_VERBS:
+            # same message older clients' degrade paths already match on
+            raise ValueError(f"unknown op {op!r}")
         s = self.store
         self.op_counts[op] += 1
         if op == "get_meta":
@@ -467,7 +511,9 @@ class GraphService:
             return [
                 s._node2vec_step(a[0], a[1], a[2], a[3], a[4], _rng_from(a[5]))
             ]
-        raise ValueError(f"unknown op {op!r}")
+        raise RuntimeError(
+            f"op {op!r} is in HANDLED_VERBS but has no dispatch arm"
+        )
 
     def _sage_minibatch(
         self, batch_size, edge_types, counts, label, node_type, seed, lean

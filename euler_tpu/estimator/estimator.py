@@ -462,9 +462,18 @@ class Estimator:
         )
         cache = _jit_cache(root)
         if cache is None:
-            self._jit_train, self._jit_train_scan = _build_train_steps(
-                self.model, self.tx, self._device_flow, self.feature_cache
-            )
+            # same lock as the shared-cache path below: an Estimator shared
+            # by serving threads with sharing disabled must still agree on
+            # ONE program pair instead of racing build-and-overwrite
+            # (build is cheap under the lock — jax.jit only wraps)
+            with _JIT_CACHE_LOCK:
+                if self._jit_train is None:
+                    self._jit_train, self._jit_train_scan = (
+                        _build_train_steps(
+                            self.model, self.tx, self._device_flow,
+                            self.feature_cache,
+                        )
+                    )
             return
         key = (
             "steps",

@@ -46,6 +46,11 @@ from euler_tpu.graph.store import DEFAULT_ID
 # sampleLNB, edge frontiers) keeps the legacy per-op execution.
 _TERMINAL_AFTER_DYNAMIC = ("as", "order_by")
 
+# The planner's own wire surface (the fused-dispatch verb of PR 1);
+# graftlint's wire-protocol checker unions this with RemoteShard's
+# WIRE_VERBS and diffs against the graph server's HANDLED_VERBS.
+WIRE_VERBS = frozenset({"exec_plan"})
+
 
 def plan_mode() -> str:
     """EULER_TPU_FUSED_PLAN: "1" → fused (default), "0" → per-op A/B

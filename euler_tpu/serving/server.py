@@ -88,10 +88,17 @@ class ModelServer:
 
     # -- _PoolServer service surface -------------------------------------
 
+    # Load-bearing: dispatch() gates on it, graftlint's wire-protocol
+    # checker diffs it against the `op ==` arms and ServingClient's
+    # WIRE_VERBS, and tests/test_wire_parity.py asserts parity at runtime.
+    HANDLED_VERBS = frozenset({"predict", "server_stats", "ping"})
+
     def is_coordinator(self, op: str) -> bool:
         return False
 
     def dispatch(self, op: str, a: list) -> list:
+        if op not in self.HANDLED_VERBS:
+            raise ValueError(f"unknown op {op!r}")
         if op == "predict":
             deadline_ms = a[1] if len(a) > 1 else None
             deadline = (
@@ -113,4 +120,6 @@ class ModelServer:
             return [json.dumps(stats)]
         if op == "ping":
             return [0]
-        raise ValueError(f"unknown op {op!r}")
+        raise RuntimeError(
+            f"op {op!r} is in HANDLED_VERBS but has no dispatch arm"
+        )

@@ -1,0 +1,49 @@
+"""Known-bad determinism fixture — seed and order hazards."""
+
+import json
+import random
+
+import jax
+import numpy as np
+
+
+def unseeded_at_call_site(graph, batch):
+    # det-unseeded-rng: fresh unseeded Generator handed to a sampler —
+    # the run can never be reproduced (the bench.py:380 bug)
+    return graph.sample(batch, rng=np.random.default_rng())
+
+
+def legacy_global_stream(n):
+    return np.random.randint(0, 10, size=n)  # det-unseeded-rng: legacy
+
+
+def stdlib_stream(items):
+    return random.choice(items)  # det-unseeded-rng: process-global
+
+
+def serialize_plan(steps):
+    verbs = set()
+    for s in steps:
+        verbs.add(s["op"])
+    # det-iter-order: set iteration order feeds serialized output
+    return json.dumps(list(verbs))
+
+
+def pytree_leaves(names):
+    uniq = set(names)
+    # det-iter-order: comprehension over a set builds pytree leaf order
+    return [np.zeros(4) for _ in uniq]
+
+
+def key_reuse_straight(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # det-key-reuse: same key, 2 draws
+    return a + b
+
+
+def key_reuse_loop(key, n):
+    out = []
+    for _ in range(n):
+        # det-key-reuse: key made outside the loop, consumed per iteration
+        out.append(jax.random.normal(key, (2,)))
+    return out

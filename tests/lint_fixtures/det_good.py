@@ -1,0 +1,62 @@
+"""Clean determinism fixture — the fixed forms of det_bad.py plus the
+idioms the checker must NOT flag. ZERO determinism findings expected."""
+
+import json
+
+import jax
+import numpy as np
+
+
+def seeded_at_call_site(graph, batch, step):
+    rng = np.random.default_rng(np.random.SeedSequence([7, step]))
+    return graph.sample(batch, rng=rng)
+
+
+def rng_fallback_ifexp(rng=None):
+    # the rng=None API-fallback idiom (expression form) is allowed:
+    # a caller passing None explicitly chose nondeterminism
+    rng = rng if rng is not None else np.random.default_rng()
+    return rng.integers(0, 10)
+
+
+def rng_fallback_stmt(rng=None):
+    if rng is None:  # statement form of the same idiom
+        rng = np.random.default_rng()
+    return rng.integers(0, 10)
+
+
+def serialize_plan(steps):
+    verbs = set()
+    for s in steps:
+        verbs.add(s["op"])
+    return json.dumps(sorted(verbs))  # sorted() pins the order
+
+
+def membership_only(names, allowed):
+    uniq = set(names)
+    # set used for membership / commutative reduction — order-free
+    total = sum(1 for n in allowed if n in uniq)
+    return total
+
+
+def keys_split(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a + b
+
+
+def key_per_iteration(key, n):
+    out = []
+    for i in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, (2,)))
+    return out
+
+
+def key_in_exclusive_branches(key, use_cdf, cdf):
+    # one draw per PATH — the _draw_roots shape; not a reuse
+    if use_cdf:
+        r = jax.random.bits(key, (8,), dtype=np.uint32)
+        return r
+    return jax.random.randint(key, (8,), 0, 10)

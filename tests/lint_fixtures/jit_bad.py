@@ -1,0 +1,53 @@
+"""Known-bad jit-purity fixture — every hazard class the checker owns.
+NOT imported by tests; parsed as data. The numbers in comments are the
+check ids test_lint.py expects to fire."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def branches_on_tracer(x, y):
+    if x > 0:  # jit-py-branch: Python if on a traced value
+        return y
+    while y.sum() < 1.0:  # jit-py-branch: Python while on a traced value
+        y = y * 2
+    return y
+
+
+@jax.jit
+def numpy_on_tracer(x):
+    return np.maximum(x, 0.0)  # jit-np-call: np.* concretizes the tracer
+
+
+@jax.jit
+def host_sync(x):
+    lo = x.min().item()  # jit-host-sync: .item() inside traced code
+    return x - lo
+
+
+@jax.jit
+def host_float(x):
+    return float(x.sum())  # jit-host-sync: float() on traced value
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def bad_static_index(a, b):  # jit-static-arg: index 5 out of range
+    return a + b
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_is_array(a, table):
+    return a + table * 2  # jit-static-arg: static param used in arithmetic
+
+
+def make_step():
+    def step(params, batch):
+        if batch.mean() > 0:  # jit-py-branch: traced via jax.jit(step)
+            return params
+        return jnp.tanh(params)
+
+    return jax.jit(step)

@@ -1,0 +1,52 @@
+"""Clean jit fixture — trace-safe versions of everything jit_bad.py does
+wrong. Must produce ZERO jit-purity findings."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_free(x, y):
+    # device-side select instead of Python control flow
+    out = jnp.where(x > 0, y, y * 2)
+    # shape/ndim/dtype reads are static at trace time — never taint
+    if x.ndim == 2:
+        out = out.reshape(x.shape[0], -1)
+    if y is None:
+        return out
+    return out
+
+
+@jax.jit
+def jnp_math(x):
+    return jnp.maximum(x, 0.0)
+
+
+@jax.jit
+def device_min(x):
+    return x - x.min()  # stays on device, no host sync
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_used_statically(a, mode):
+    # static param drives trace-time specialization — the intended use
+    if mode == "relu":
+        return jnp.maximum(a, 0.0)
+    return a
+
+
+def make_step():
+    def step(params, batch):
+        scale = jnp.where(batch.mean() > 0, 1.0, 0.5)
+        return jnp.tanh(params) * scale
+
+    return jax.jit(step)
+
+
+def host_helper(x):
+    # NOT traced — host code may branch on values freely
+    if x > 0:
+        return float(x)
+    return 0.0

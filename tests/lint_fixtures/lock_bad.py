@@ -1,0 +1,62 @@
+"""Known-bad lock-discipline fixture.
+
+Includes the regression case the ISSUE pins: the pre-PR-2 `_jit_cache`
+attribute-injection get-or-build, raced by serving threads."""
+
+import threading
+
+
+class JitCacheRace:
+    """The pre-PR-2 estimator pattern: programs cached by attribute
+    injection onto the flow, built check-then-act with no lock, from a
+    thread-pool serving path."""
+
+    def __init__(self, flow):
+        self.flow = flow
+        self._worker = threading.Thread(target=self._serve_loop, daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def _serve_loop(self):
+        while True:
+            self._get_or_build()
+
+    def _get_or_build(self):
+        flow = self.flow
+        # lock-racy-init: two serving threads can both see the attribute
+        # missing and both build (then race the dict insert)
+        if not hasattr(flow, "_jit_cache"):
+            flow._jit_cache = {}
+        return flow._jit_cache
+
+
+class MixedWrites:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs = {}
+        self.generation = 0
+
+    def rebuild(self, key, value):
+        with self._lock:
+            self._programs[key] = value
+            self.generation += 1
+
+    def clear_unlocked(self):
+        # lock-mixed-write: same state the locked writers mutate
+        self._programs = {}
+        self.generation = 0
+
+
+class LazyOnConcurrentClass:
+    """A class that owns a lock declares itself concurrent — unlocked
+    lazy init of shared state is check-then-act."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = None
+
+    def table(self):
+        if self._table is None:  # lock-racy-init
+            self._table = {"built": True}
+        return self._table

@@ -1,0 +1,54 @@
+"""Clean lock-discipline fixture — the fixed forms of lock_bad.py.
+Must produce ZERO lock-discipline findings."""
+
+import threading
+
+_TLS = threading.local()
+
+
+class JitCacheFixed:
+    """The post-PR-2 shape: get-or-build under a lock (double-checked)."""
+
+    def __init__(self, flow):
+        self.flow = flow
+        self._lock = threading.Lock()
+        self._jit_cache = {}
+        self._worker = threading.Thread(target=self._serve_loop, daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def _serve_loop(self):
+        while True:
+            self._get_or_build("k")
+
+    def _get_or_build(self, key):
+        if key not in self._jit_cache:
+            with self._lock:
+                if key not in self._jit_cache:
+                    self._jit_cache[key] = {}
+        return self._jit_cache[key]
+
+
+class ConsistentWrites:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs = {}
+        self.generation = 0
+
+    def rebuild(self, key, value):
+        with self._lock:
+            self._programs[key] = value
+            self.generation += 1
+
+    def clear(self):
+        with self._lock:
+            self._programs = {}
+            self.generation = 0
+
+
+def thread_confined():
+    # attributes of threading.local() are per-thread — lazy init is fine
+    if getattr(_TLS, "buf", None) is None:
+        _TLS.buf = []
+    return _TLS.buf

@@ -1,0 +1,20 @@
+"""Known-bad wire fixture, client half: sends a verb no server handles,
+and declares a table that drifted from what it actually sends."""
+
+
+class BadClient:
+    # wire-table-drift: lists 'legacy_lookup' (never sent), misses 'lookup'
+    WIRE_VERBS = frozenset({"legacy_lookup", "sample"})
+
+    def __init__(self, shard):
+        self.shard = shard
+
+    def lookup(self, ids):
+        return self.shard.call("lookup", [ids])
+
+    def sample(self, n):
+        return self.shard.call("sample", [n])
+
+    def fused_query(self, plan):
+        # wire-unhandled: the server never grew an 'exec_plan' arm
+        return self.shard.call("exec_plan", [plan])

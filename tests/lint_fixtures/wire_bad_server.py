@@ -1,0 +1,15 @@
+"""Known-bad wire fixture, server half: dispatches a verb no client
+sends (a renamed client send left this arm dead)."""
+
+
+class BadServer:
+    HANDLED_VERBS = frozenset({"lookup", "sample", "stats"})
+
+    def dispatch(self, op, a):
+        if op == "lookup":
+            return [a[0]]
+        if op == "sample":
+            return [a[0]]
+        if op == "stats":  # wire-unreachable: no client sends 'stats'
+            return ["{}"]
+        raise ValueError(f"unknown op {op!r}")

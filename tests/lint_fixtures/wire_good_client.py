@@ -1,0 +1,18 @@
+"""Clean wire fixture, client half — sends exactly what the server
+handles and declares it truthfully."""
+
+
+class GoodClient:
+    WIRE_VERBS = frozenset({"lookup", "sample", "stats"})
+
+    def __init__(self, shard):
+        self.shard = shard
+
+    def lookup(self, ids):
+        return self.shard.call("lookup", [ids])
+
+    def sample(self, n):
+        return self.shard.call("sample", [n])
+
+    def stats(self):
+        return self.shard.call("stats", [])

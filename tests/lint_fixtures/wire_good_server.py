@@ -1,0 +1,16 @@
+"""Clean wire fixture, server half."""
+
+
+class GoodServer:
+    HANDLED_VERBS = frozenset({"lookup", "sample", "stats"})
+
+    def dispatch(self, op, a):
+        if op not in self.HANDLED_VERBS:
+            raise ValueError(f"unknown op {op!r}")
+        if op == "lookup":
+            return [a[0]]
+        if op == "sample":
+            return [a[0]]
+        if op == "stats":
+            return ["{}"]
+        raise RuntimeError("in table but unimplemented")

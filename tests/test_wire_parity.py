@@ -1,0 +1,128 @@
+"""Runtime twin of graftlint's wire-protocol checker (ISSUE 3 satellite):
+instantiate the REAL client and server verb tables and assert they match
+— no source grep, no AST. If someone adds a dispatch arm or a client
+send without updating the tables, the static checker catches the drift;
+if someone edits a table wrong, THIS catches it against live dispatch.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from euler_tpu.distributed.client import RemoteShard
+from euler_tpu.distributed.service import GraphService
+from euler_tpu.query import plan as query_plan
+from euler_tpu.serving.client import ServingClient
+from euler_tpu.serving.server import ModelServer
+
+
+def test_graph_domain_tables_match():
+    client_verbs = set(RemoteShard.WIRE_VERBS) | set(query_plan.WIRE_VERBS)
+    assert client_verbs == set(GraphService.HANDLED_VERBS), (
+        "graph-protocol verb tables diverged:\n"
+        f"  client-only: {sorted(client_verbs - GraphService.HANDLED_VERBS)}\n"
+        f"  server-only: {sorted(GraphService.HANDLED_VERBS - client_verbs)}"
+    )
+
+
+def test_serving_domain_tables_match():
+    assert set(ServingClient.WIRE_VERBS) == set(ModelServer.HANDLED_VERBS)
+
+
+def test_graph_dispatch_honors_its_table(graph1):
+    """Every verb in HANDLED_VERBS must reach a real dispatch arm (bogus
+    args may fail loudly, but never as unknown-op), and anything outside
+    the table must be rejected before touching the store."""
+    svc = GraphService(graph1.shards[0], graph1.meta, shard=0)
+    try:
+        for verb in sorted(GraphService.HANDLED_VERBS):
+            try:
+                svc.dispatch(verb, [])
+            except ValueError as e:
+                assert "unknown op" not in str(e), (
+                    f"{verb!r} is in HANDLED_VERBS but dispatch rejected it"
+                )
+            except Exception:
+                pass  # bogus args — reaching the arm is what's asserted
+        with pytest.raises(ValueError, match="unknown op"):
+            svc.dispatch("definitely_not_a_verb", [])
+    finally:
+        svc.server.server_close()
+
+
+class _ZeroRuntime:
+    buckets = (8,)
+
+    def predict(self, ids):
+        return np.zeros((len(ids), 4), np.float32)
+
+
+def test_serving_dispatch_honors_its_table():
+    srv = ModelServer(_ZeroRuntime(), max_wait_us=0)
+    try:
+        assert srv.dispatch("ping", []) == [0]
+        stats = json.loads(srv.dispatch("server_stats", [])[0])
+        assert "requests" in stats
+        emb = srv.dispatch("predict", [np.arange(3, dtype=np.uint64), None])
+        assert emb[0].shape == (3, 4)
+        with pytest.raises(ValueError, match="unknown op"):
+            srv.dispatch("definitely_not_a_verb", [])
+    finally:
+        srv.stop()
+
+
+def test_remote_shard_client_surface_stays_inside_its_table():
+    """The table is the outer bound of what call()/submit() may put on
+    the wire — a RemoteShard whose transport records instead of sending
+    proves every public RPC method emits a declared verb."""
+    sent = []
+
+    class _Recording(RemoteShard):
+        def call(self, op, values):
+            sent.append(op)
+            raise ConnectionError("recording only")
+
+    shard = _Recording(0, [("127.0.0.1", 1)])
+    probes = [
+        lambda: shard.lookup([1]),
+        lambda: shard.node_type([1]),
+        lambda: shard.sample_node(1),
+        lambda: shard.sample_edge(1),
+        lambda: shard.sample_neighbor([1]),
+        lambda: shard.sample_neighbor_rows([1]),
+        lambda: shard.unit_edge_weights(),
+        lambda: shard.get_full_neighbor([1]),
+        lambda: shard.get_top_k_neighbor([1]),
+        lambda: shard.degree_sum([1]),
+        lambda: shard.sample_neighbor_layerwise([1]),
+        lambda: shard.get_dense_feature([1], ["f"]),
+        lambda: shard.get_dense_by_rows([0], ["f"]),
+        lambda: shard.get_dense_feature_udf([1], ["f"], ["mean"]),
+        lambda: shard.get_sparse_feature([1], ["f"]),
+        lambda: shard.get_binary_feature([1], ["f"]),
+        lambda: shard.get_edge_dense_feature([1], ["f"]),
+        lambda: shard.get_edge_sparse_feature([1], ["f"]),
+        lambda: shard.get_edge_binary_feature([1], ["f"]),
+        lambda: shard.get_graph_by_label([1]),
+        lambda: shard.random_walk([1]),
+        lambda: shard._node2vec_step([1], [1], None, 1.0, 1.0, None),
+        lambda: shard.sample_node_with_condition(1, None),
+        lambda: shard.sample_edge_with_condition(1, None),
+        lambda: shard.condition_mask([1], None),
+        lambda: shard.get_node_ids_by_condition(None),
+        lambda: shard.search_condition(None),
+        lambda: shard.fanout_with_rows([1], None, [2]),
+        lambda: shard.sage_minibatch(1, None, [2]),
+        lambda: shard.ping(),
+        lambda: shard.stats(),
+        lambda: shard.num_nodes,
+    ]
+    for probe in probes:
+        try:
+            probe()
+        except Exception:
+            pass  # the transport always fails; we only record the verb
+    assert sent, "recording transport saw no traffic"
+    stray = set(sent) - set(RemoteShard.WIRE_VERBS)
+    assert not stray, f"client methods sent undeclared verbs: {sorted(stray)}"
