@@ -522,6 +522,108 @@ def _emit_serving(value: float, extra: dict) -> None:
     )
 
 
+def run_recovery(platform: str) -> tuple[float, dict]:
+    """The recovery lane (ISSUE 4): time-to-first-successful-batch after a
+    seeded replica kill, plus the steady-state overhead of the
+    deadline/retry plumbing (envelope on vs off on the same stream — must
+    stay within noise, or the remote lane just paid for robustness).
+
+    A 1-shard x 2-replica in-process cluster is enough: the lane measures
+    failover latency and client-side plumbing cost, not graph throughput
+    (the remote leg owns that)."""
+    import tempfile
+
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.datasets.synthetic import random_graph
+    from euler_tpu.distributed import (
+        Fault,
+        FaultPlan,
+        chaos,
+        connect,
+        serve_shard,
+    )
+    from euler_tpu.graph import format as tformat
+
+    num_nodes = 2000 if SMOKE else 20_000
+    batch, steps = (32, 6) if SMOKE else (256, 20)
+    g = random_graph(
+        num_nodes=num_nodes, out_degree=10, feat_dim=16, seed=9
+    )
+    d = tempfile.mkdtemp(prefix="etpu_recovery_")
+    tformat.write_arrays(os.path.join(d, "part_0"), g.shards[0].arrays)
+    g.meta.save(d)
+    s_a = serve_shard(d, 0, native=False)
+    s_b = serve_shard(d, 0, native=False)
+    try:
+        remote = connect(
+            cluster={
+                0: [("127.0.0.1", s_a.port), ("127.0.0.1", s_b.port)]
+            }
+        )
+        shard = remote.shards[0]
+        shard.QUARANTINE_S = 0.5
+        flow = SageDataFlow(
+            remote, ["feat"], fanouts=[10], label_feature="label",
+            rng=np.random.default_rng(0), feature_mode="rows", lean=True,
+        )
+
+        def measure(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                flow.minibatch(batch)
+            return (time.perf_counter() - t0) / n * 1e3  # ms/batch
+
+        measure(3)  # warm sockets + caches
+        per_batch_on_ms = measure(steps)  # deadline envelope on (default)
+        shard._deadline_wire = False
+        per_batch_off_ms = measure(steps)  # plain ops: pre-PR-4 wire
+        shard._deadline_wire = True
+        overhead_pct = (
+            (per_batch_on_ms - per_batch_off_ms)
+            / max(per_batch_off_ms, 1e-9) * 100.0
+        )
+
+        # seeded replica kill: replica A resets on every touch from now
+        # on; the NEXT batch must fail over inside the deadline
+        retries_before = shard.retry_count
+        chaos.install(
+            FaultPlan(
+                [Fault(site="client", kind="reset",
+                       replica=("127.0.0.1", s_a.port))],
+                seed=1,
+            )
+        )
+        try:
+            t0 = time.perf_counter()
+            flow.minibatch(batch)
+            ttfb_ms = (time.perf_counter() - t0) * 1e3
+            post_kill_ms = measure(steps)  # steady state on the survivor
+        finally:
+            chaos.uninstall()
+        extra = {
+            "backend": platform + ("-fallback" if CPU_FALLBACK else ""),
+            "per_batch_ms": round(per_batch_on_ms, 3),
+            "per_batch_ms_no_deadline_wire": round(per_batch_off_ms, 3),
+            "deadline_wire_overhead_pct": round(overhead_pct, 2),
+            "post_kill_per_batch_ms": round(post_kill_ms, 3),
+            "failover_retries": shard.retry_count - retries_before,
+            "rpc_count": shard.rpc_count,
+        }
+        return ttfb_ms, extra
+    finally:
+        s_a.stop()
+        s_b.stop()
+
+
+def _emit_recovery(value: float, extra: dict) -> None:
+    emit(
+        value, extra,
+        metric="rpc_recovery_time_to_first_batch_ms",
+        unit="ms",
+        baseline=None,
+    )
+
+
 _DATASET_GEN_V = 2  # bump when the synthetic generator changes, so cached
 # /tmp datasets from older generator code are never silently reused
 
@@ -785,6 +887,7 @@ def main():
         return
     remote_enabled = os.environ.get("EULER_BENCH_REMOTE", "1") != "0"
     serving_enabled = os.environ.get("EULER_BENCH_SERVING", "1") != "0"
+    recovery_enabled = os.environ.get("EULER_BENCH_RECOVERY", "1") != "0"
 
     # ---- LOCAL leg first: the headline artifact is emitted before the
     # remote leg can spend a second of the driver's timeout (VERDICT r3 #1).
@@ -822,11 +925,32 @@ def main():
             traceback.print_exc()
             _emit_serving(0.0, {"backend": platform, "error": repr(e)[:300]})
 
+    # ---- RECOVERY lane: seeded replica kill against a tiny in-process
+    # replica pair — seconds of wall clock, emitted immediately.
+    if recovery_enabled and "--remote-only" not in sys.argv:
+        try:
+            r_value, r_extra = run_recovery(platform)
+            _emit_recovery(r_value, r_extra)
+            extra = dict(
+                extra,
+                recovery_ttfb_ms=round(float(r_value), 1),
+                recovery_deadline_wire_overhead_pct=r_extra[
+                    "deadline_wire_overhead_pct"
+                ],
+            )
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            _emit_recovery(
+                0.0, {"backend": platform, "error": repr(e)[:300]}
+            )
+
     if not remote_enabled:
         if "--remote-only" in sys.argv:
             # never exit silently: the contract is at least one JSON line
             emit(0.0, {"error": "--remote-only with EULER_BENCH_REMOTE=0"})
-        elif serving_enabled and value is not None:
+        elif (serving_enabled or recovery_enabled) and value is not None:
             # the serving lane printed after the headline; re-emit the
             # headline (serving summary attached) so BOTH first-line and
             # last-line parsers still read the local number

@@ -13,6 +13,14 @@ Check ids:
                      target, or on a class that owns locks (a class that
                      declares a lock declares itself concurrent) — the
                      pre-PR-2 ``_jit_cache`` attribute-injection race
+  lock-unguarded-write — within one class, an attribute of a non-self
+                     object (``obj.x`` where obj is a local, e.g. a pooled
+                     element picked under the lock) is READ under a lock
+                     in one method but WRITTEN lock-free in another — the
+                     reader's invariant can be torn mid-scan. The pre-PR-4
+                     ``RemoteShard``: ``_pick`` read ``r.bad_until`` under
+                     ``self._lock`` while the failure path wrote it
+                     unlocked.
 
 Lock identity is syntactic: ``with self._lock:`` guards writes spelled
 under it; the guarded-state inference is "other writes of the same name
@@ -110,6 +118,11 @@ class _FunctionScanner(ast.NodeVisitor):
         self.declared_globals = declared_globals
         self.locks: list[str] = []
         self.writes: dict[str, list[_Write]] = {}
+        # non-self object attributes (``obj.x``, obj a plain local name):
+        # keyed "<Cls>.*.x" — the local name varies per method, the
+        # attribute is the shared-state identity (pool elements)
+        self.obj_writes: dict[str, list[_Write]] = {}
+        self.obj_reads: dict[str, list[frozenset]] = {}  # key -> lock sets
         self.lazy_inits: list[tuple[str, int, str]] = []  # key, line, detail
         self.tls = mod.symbols.thread_local_names()
         self.init = qual.rpartition(".")[2] in _INIT_FUNCS
@@ -145,6 +158,29 @@ class _FunctionScanner(ast.NodeVisitor):
         if base in self.tls or d in self.tls:
             return None
         return None
+
+    def _obj_key(self, node: ast.AST) -> str | None:
+        """"<Cls>.*.attr" for ``obj.attr`` where obj is a plain local name
+        (not self/cls/thread-local). The local name varies per method —
+        ``r`` in the picker, ``replica`` in the failure path — so the
+        ATTRIBUTE is the shared-state identity, scoped to the class."""
+        if self.cls is None or not isinstance(node, ast.Attribute):
+            return None
+        if not isinstance(node.value, ast.Name):
+            return None
+        base = node.value.id
+        if base in ("self", "cls") or base in self.tls:
+            return None
+        if f"{base}.{node.attr}" in self.tls:
+            return None
+        return f"{self.cls}.*.{node.attr}"
+
+    def _record_obj_write(self, target: ast.AST, line: int):
+        key = self._obj_key(target)
+        if key is not None:
+            self.obj_writes.setdefault(key, []).append(
+                _Write(self.qual, line, self.locks, self.init, "assign")
+            )
 
     def _mutation_key(self, base: ast.AST) -> str | None:
         """Key for mutations THROUGH a name (x[k]=v, x.append(...)):
@@ -218,8 +254,10 @@ class _FunctionScanner(ast.NodeVisitor):
                         )
                     else:
                         self._record(self._key(e), node.lineno, "assign")
+                        self._record_obj_write(e, node.lineno)
             else:
                 self._record(self._key(t), node.lineno, "assign")
+                self._record_obj_write(t, node.lineno)
         self.generic_visit(node.value)
 
     def visit_AugAssign(self, node: ast.AugAssign):
@@ -229,7 +267,19 @@ class _FunctionScanner(ast.NodeVisitor):
             )
         else:
             self._record(self._key(node.target), node.lineno, "assign")
+            self._record_obj_write(node.target, node.lineno)
         self.generic_visit(node.value)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        # attribute READS while holding a lock: the evidence that makes a
+        # lock-free write of the same attribute elsewhere a torn-read bug
+        if isinstance(node.ctx, ast.Load) and self.locks:
+            key = self._obj_key(node)
+            if key is not None:
+                self.obj_reads.setdefault(key, []).append(
+                    frozenset(self.locks)
+                )
+        self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call):
         if (
@@ -332,6 +382,8 @@ def _scan_module(mod: Module) -> list[Finding]:
     thread_reach = cg.thread_reachable()
 
     all_writes: dict[str, list[_Write]] = {}
+    all_obj_writes: dict[str, list[_Write]] = {}
+    all_obj_reads: dict[str, list[frozenset]] = {}
     lazy: list[tuple[str, str, int, str]] = []  # qual, key, line, how
 
     # per-function declared globals
@@ -348,6 +400,10 @@ def _scan_module(mod: Module) -> list[Finding]:
             sc.visit(stmt)
         for key, ws in sc.writes.items():
             all_writes.setdefault(key, []).extend(ws)
+        for key, ws in sc.obj_writes.items():
+            all_obj_writes.setdefault(key, []).extend(ws)
+        for key, locks in sc.obj_reads.items():
+            all_obj_reads.setdefault(key, []).extend(locks)
         for key, line, how in sc.lazy_inits:
             lazy.append((qual, key, line, how))
 
@@ -388,6 +444,29 @@ def _scan_module(mod: Module) -> list[Finding]:
                     f" written here without {lock_names}, but written under"
                     f" it in {'; '.join(others)} — either every writer"
                     " holds the lock or none does",
+                )
+            )
+
+    # -- lock-unguarded-write ---------------------------------------------
+    for key, read_locksets in sorted(all_obj_reads.items()):
+        read_locks = set().union(*read_locksets)
+        for w in all_obj_writes.get(key, []):
+            if w.init or (w.locks & read_locks):
+                continue
+            cls_name, _, attr = key.partition(".*.")
+            lock_names = ", ".join(sorted(read_locks))
+            findings.append(
+                Finding(
+                    "lock-unguarded-write",
+                    CHECKER,
+                    mod.relpath,
+                    w.line,
+                    w.qual,
+                    f"`<obj>.{attr}` written here lock-free, but {cls_name}"
+                    f" reads it under {lock_names} — the locked reader's"
+                    " scan can observe a torn update (the pre-PR-4"
+                    " RemoteShard.bad_until quarantine race); move the"
+                    " write under the lock",
                 )
             )
 

@@ -1,5 +1,11 @@
 from euler_tpu.distributed.client import RemoteShard, RpcError, connect  # noqa: F401
+from euler_tpu.distributed.chaos import Fault, FaultPlan  # noqa: F401
+from euler_tpu.distributed.errors import (  # noqa: F401
+    DeadlineExceeded,
+    OverloadError,
+)
 from euler_tpu.distributed.registry import Registry  # noqa: F401
+from euler_tpu.distributed.retry import RetryBudget, RetryPolicy  # noqa: F401
 from euler_tpu.distributed.service import GraphService, serve_shard  # noqa: F401
 from euler_tpu.distributed.rendezvous import (  # noqa: F401
     RendezvousServer,

@@ -3,29 +3,43 @@
 The reference's client stack (euler/client/): `RpcManager` keeps round-robin
 replica channels per shard with bad-host quarantine + timed revival
 (rpc_manager.h:66-124) and retries calls up to 10× (rpc_client.h:32-66).
-`RemoteShard` reproduces that contract over the wire protocol; `connect`
-assembles a standard `Graph` facade whose shards are remote, so every
-dataflow/estimator works unchanged against a cluster.
+`RemoteShard` reproduces that contract over the wire protocol — and adds
+the discipline around the retry loop: a per-call deadline that propagates
+on the wire (EULER_TPU_RPC_TIMEOUT_S; socket timeouts derive from the
+remaining budget), exponential backoff with deterministic seeded jitter,
+and a per-shard retry budget that fails fast instead of joining a retry
+storm (distributed/retry.py). Typed server verdicts (`RpcError` and its
+subclasses) are never transport-retried. `connect` assembles a standard
+`Graph` facade whose shards are remote, so every dataflow/estimator works
+unchanged against a cluster.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
 
 import numpy as np
 
-from euler_tpu.distributed import wire
+from euler_tpu.distributed import chaos, wire
+from euler_tpu.distributed.errors import (  # noqa: F401 (re-exports)
+    DeadlineExceeded,
+    OverloadError,
+    RpcError,
+    from_wire,
+)
 from euler_tpu.distributed.registry import Registry  # noqa: F401 (re-export)
 from euler_tpu.distributed.rendezvous import make_registry
+from euler_tpu.distributed.retry import (
+    RetryBudget,
+    RetryPolicy,
+    default_timeout_s,
+)
 from euler_tpu.graph.meta import GraphMeta
 from euler_tpu.graph.store import Graph
-
-
-class RpcError(RuntimeError):
-    pass
 
 
 class _DaemonExecutor:
@@ -71,6 +85,20 @@ class _DaemonExecutor:
         return fut
 
     def close(self):
+        # cancel still-pending jobs FIRST: a sentinel enqueued behind a
+        # pending job would let the worker exit while the job's future
+        # stays forever unresolved — a waiter on a submitted-but-unstarted
+        # RPC would hang until process exit
+        import queue as queue_mod
+
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue_mod.Empty:
+                break
+            if item is None:
+                continue
+            item[0].cancel()  # pending Future -> CancelledError for waiters
         for _ in self._threads:
             self._q.put(None)
 
@@ -81,16 +109,21 @@ def _seed(rng) -> int:
 
 
 class _Replica:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, shard: int | None = None):
         self.host = host
         self.port = port
+        self.shard = shard  # chaos-plan matching + diagnostics only
         self.bad_until = 0.0
         self._local = threading.local()
 
-    def _sock(self) -> socket.socket:
+    def _sock(self, timeout_s: float | None = None) -> socket.socket:
         sock = getattr(self._local, "sock", None)
         if sock is None:
-            sock = socket.create_connection((self.host, self.port), timeout=30)
+            sock = socket.create_connection(
+                (self.host, self.port),
+                timeout=timeout_s if timeout_s is not None
+                else default_timeout_s(),
+            )
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._local.sock = sock
         return sock
@@ -104,9 +137,35 @@ class _Replica:
                 pass
             self._local.sock = None
 
-    def call(self, op: str, values: list) -> list:
-        sock = self._sock()
-        wire.send_frame(sock, wire.encode(op, values))
+    def call(
+        self,
+        op: str,
+        values: list,
+        timeout_s: float | None = None,
+        budget_ms: float | None = None,
+    ) -> list:
+        """One attempt: no retries at this layer.
+
+        timeout_s bounds the socket (connect/send/recv) — derived by the
+        caller from its remaining deadline; budget_ms (when the peer
+        speaks the envelope) ships that remaining budget so the server
+        can reject already-expired work before dispatch."""
+        plan = chaos.active_plan()
+        if plan is not None:
+            # may raise the transport error the fault models — BEFORE any
+            # bytes move, so the server's state is untouched and the
+            # retried call (same client-drawn seed) replays exactly
+            plan.apply_client(
+                self.shard, (self.host, self.port), op, timeout_s
+            )
+        sock = self._sock(timeout_s)
+        sock.settimeout(
+            timeout_s if timeout_s is not None else default_timeout_s()
+        )
+        wire_op = (
+            op if budget_ms is None else wire.wrap_deadline(op, budget_ms)
+        )
+        wire.send_frame(sock, wire.encode(wire_op, values))
         payload = wire.read_frame(sock)
         if payload is None:
             # clean EOF — the server closed this connection (shutdown or
@@ -115,7 +174,7 @@ class _Replica:
             raise ConnectionError("connection closed by peer")
         status, result = wire.decode(payload)
         if status == "err":
-            raise RpcError(result[0])
+            raise from_wire(result[0])
         return result
 
 
@@ -183,18 +242,37 @@ class RemoteShard:
         "unit_edge_weights",
     })
 
-    def __init__(self, shard: int, replicas: list[tuple[str, int]]):
+    def __init__(
+        self,
+        shard: int,
+        replicas: list[tuple[str, int]],
+        retry_policy: RetryPolicy | None = None,
+    ):
         self.shard = shard
-        self.replicas = [_Replica(h, p) for h, p in replicas]
+        self.replicas = [_Replica(h, p, shard) for h, p in replicas]
         self._rr = 0
         self._lock = threading.Lock()
         self._num_nodes: int | None = None
         self._unit_w: dict[tuple | None, bool] = {}
         self._pool = None  # lazy in-flight request executor
+        # per-shard jitter stream seeded by shard index: deterministic
+        # backoff schedules per shard, distinct across shards
+        self.retry_policy = retry_policy or RetryPolicy.from_env(seed=shard)
+        self._budget = RetryBudget(
+            cap=float(os.environ.get("EULER_TPU_RPC_RETRY_BUDGET", 16.0))
+        )
+        # sticky downgrade: peers predating the deadline envelope answer
+        # it with unknown-op; after one such answer this shard resends
+        # plain ops (deadlines then bound only the client side)
+        self._deadline_wire = True
         # logical RPCs issued through this shard handle (retries count
         # once) — the client half of the planner's L×P → P measurement;
         # GIL-racy increments are fine for telemetry
         self.rpc_count = 0
+        # transport faults that triggered a failover retry — with
+        # rpc_count, the proof that recovery was failover, not silent
+        # skipping (GIL-racy increments fine: telemetry)
+        self.retry_count = 0
 
     def _executor(self) -> _DaemonExecutor:
         """Bounded executor for overlapped requests — the async
@@ -247,7 +325,7 @@ class RemoteShard:
 
     def add_replica(self, host: str, port: int):
         with self._lock:
-            self.replicas.append(_Replica(host, port))
+            self.replicas.append(_Replica(host, port, self.shard))
 
     def _pick(self) -> _Replica:
         with self._lock:
@@ -260,23 +338,85 @@ class RemoteShard:
             # all quarantined: take the least-recently-failed (timed revival)
             return min(self.replicas, key=lambda r: r.bad_until)
 
-    def call(self, op: str, values: list) -> list:
+    def call(self, op: str, values: list, deadline_s: float | None = None) -> list:
+        """One logical RPC: failover retries under a deadline.
+
+        Every attempt derives its socket timeout from the remaining
+        budget (capped by the policy's per-attempt timeout so one
+        blackholed replica can't eat the whole deadline) and ships the
+        remaining budget on the wire. Transport faults quarantine the
+        replica, spend a retry-budget token, back off with deterministic
+        jitter, and fail over; typed server errors (`RpcError` and
+        subclasses) raise immediately — retrying a deterministic verdict
+        only recomputes it."""
+        policy = self.retry_policy
+        budget_s = policy.deadline_budget_s(deadline_s)
+        deadline = time.monotonic() + budget_s
+        attempts = policy.retries or self.RETRIES
+        rng = None  # jitter stream built lazily: only failing calls pay
         err: Exception | None = None
         self.rpc_count += 1
-        for _ in range(self.RETRIES):
+        attempt = 0
+        while attempt < attempts:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise DeadlineExceeded(
+                    f"shard {self.shard}: {op!r} budget ({budget_s:.3f}s)"
+                    f" exhausted after {attempt} attempt(s): {err}"
+                )
             r = self._pick()
             try:
-                return r.call(op, values)
+                out = r.call(
+                    op,
+                    values,
+                    timeout_s=min(remaining, policy.attempt_timeout_s),
+                    budget_ms=(
+                        remaining * 1e3 if self._deadline_wire else None
+                    ),
+                )
+                self._budget.on_success()
+                return out
             except RpcError as e:
+                if self._deadline_wire and self._envelope_unknown(e):
+                    # pre-deadline-wire peer: degrade the envelope
+                    # (sticky) and resend plain — not a transport retry
+                    self._deadline_wire = False
+                    continue
                 # server-side error: deterministic, don't failover-retry
                 raise
             except (OSError, ConnectionError, ValueError) as e:
                 err = e
+                self.retry_count += 1
                 r.drop()
-                r.bad_until = time.time() + self.QUARANTINE_S
+                # quarantine under the pool lock: _pick reads bad_until
+                # under it, and an unguarded write could be reordered
+                # against a racing reader's round-robin scan
+                with self._lock:
+                    r.bad_until = time.time() + self.QUARANTINE_S
+                attempt += 1
+                if attempt >= attempts:
+                    break
+                if not self._budget.try_spend():
+                    raise RpcError(
+                        f"shard {self.shard}: retry budget exhausted"
+                        f" (replicas failing systematically): {err}"
+                    )
+                if attempt == 1:  # first retry builds this call's stream
+                    rng = policy.call_rng()
+                pause = min(
+                    policy.backoff_s(attempt - 1, rng),
+                    max(deadline - time.monotonic(), 0.0),
+                )
+                if pause > 0:
+                    time.sleep(pause)
         raise RpcError(
-            f"shard {self.shard}: all retries failed: {err}"
+            f"shard {self.shard}: all {attempts} attempts failed: {err}"
         )
+
+    @staticmethod
+    def _envelope_unknown(e: Exception) -> bool:
+        msg = str(e)
+        return "unknown op" in msg and wire.DEADLINE_PREFIX in msg
 
     # -- GraphStore surface ---------------------------------------------
 
@@ -688,6 +828,21 @@ def connect(
     shards = [
         RemoteShard(s, cluster[s]) for s in sorted(cluster)
     ]
-    meta_json = shards[0].call("get_meta", [])[0]
+    # any shard can answer get_meta (the meta describes the whole graph):
+    # fall through the shard list so cluster bring-up order — shard 0's
+    # replicas still booting or already dead — can't wedge the client
+    meta_json = None
+    err: Exception | None = None
+    for sh in shards:
+        try:
+            meta_json = sh.call("get_meta", [])[0]
+            break
+        except RpcError as e:
+            err = e
+    if meta_json is None:
+        raise RpcError(
+            f"connect: get_meta failed on every shard"
+            f" ({len(shards)} tried): {err}"
+        )
     meta = GraphMeta.from_dict(json.loads(meta_json))
     return Graph(meta, shards)

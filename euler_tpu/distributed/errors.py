@@ -1,0 +1,63 @@
+"""Typed RPC errors — the failure vocabulary of the distributed layer.
+
+The reference's RPC status codes make every failure machine-dispatchable
+(rpc_client.h:32-66 retries transport faults, surfaces server verdicts);
+here the same split is a small exception hierarchy that crosses the wire
+as an err-frame name prefix ("DeadlineExceeded: ..."):
+
+  RpcError          — deterministic server-side failure. NEVER
+                      transport-retried: the server computed this answer,
+                      a replica failover would just recompute it.
+    DeadlineExceeded — the call's time budget ran out (client-side retry
+                      loop, or server-side rejection of already-expired
+                      work before dispatch).
+    OverloadError    — admission control refused the request (bounded
+                      queue full). Retrying amplifies the overload it
+                      signals; callers own backoff.
+
+Transport faults (OSError/ConnectionError/timeout/torn frame) are NOT in
+this hierarchy on purpose — those are the retryable class.
+
+This module imports nothing so every layer (wire, client, server,
+serving, chaos) can depend on it without cycles.
+"""
+
+from __future__ import annotations
+
+
+class RpcError(RuntimeError):
+    """Deterministic server-side error — do not failover-retry."""
+
+
+class DeadlineExceeded(RpcError):
+    """The call's time budget expired (client loop or server reject)."""
+
+
+class OverloadError(RpcError):
+    """Admission control refused the request (bounded queue full)."""
+
+
+# pre-PR-4 serving name; same class, so except-clauses written against
+# either name keep working and the wire prefix stays one canonical string
+DeadlineExceededError = DeadlineExceeded
+
+# err-frame name prefix -> exception class. "DeadlineExceededError" stays
+# for frames from pre-PR-4 servers whose batcher raised under the old name.
+WIRE_ERRORS = {
+    "RpcError": RpcError,
+    "DeadlineExceeded": DeadlineExceeded,
+    "DeadlineExceededError": DeadlineExceeded,
+    "OverloadError": OverloadError,
+}
+
+
+def from_wire(message: str) -> RpcError:
+    """Typed exception for an err-frame payload.
+
+    Server frames carry "<TypeName>: <detail>"; unknown names degrade to
+    plain RpcError so new server-side error types never crash old
+    clients — they just lose retry-exemption specificity (all RpcErrors
+    are exempt anyway)."""
+    name = message.split(":", 1)[0].strip()
+    cls = WIRE_ERRORS.get(name, RpcError)
+    return cls(message)

@@ -17,11 +17,13 @@ import os
 import queue
 import selectors
 import socket
+import struct
 import threading
+import time
 
 import numpy as np
 
-from euler_tpu.distributed import wire
+from euler_tpu.distributed import chaos, wire
 from euler_tpu.distributed.registry import Registry
 from euler_tpu.distributed.rendezvous import make_registry
 from euler_tpu.graph import format as tformat
@@ -31,6 +33,18 @@ from euler_tpu.graph.store import GraphStore
 
 def _rng_from(seed) -> np.random.Generator:
     return np.random.default_rng(seed if seed is not None else None)
+
+
+# per-request context (worker-thread confined): the absolute monotonic
+# deadline unwrapped from the wire envelope, readable by services whose
+# dispatch wants it (ModelServer derives the batcher deadline from it)
+_REQUEST = threading.local()
+
+
+def current_deadline() -> float | None:
+    """Absolute time.monotonic() deadline of the request this worker is
+    dispatching, or None when the client sent no budget."""
+    return getattr(_REQUEST, "deadline", None)
 
 
 class _PoolServer:
@@ -80,6 +94,11 @@ class _PoolServer:
         )
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+        # drain support: requests currently queued or executing; guarded
+        # by the condition so drain() can wait for quiescence
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._accepting = True
 
     def start(self):
         self._sel.register(self.lsock, selectors.EVENT_READ, "accept")
@@ -95,6 +114,31 @@ class _PoolServer:
             c = threading.Thread(target=self._coordinator, daemon=True)
             c.start()
             self._threads.append(c)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful quiesce: stop accepting NEW connections, then wait for
+        every queued/executing request to finish (requests already in the
+        pipe on parked connections still get answers). True when the
+        server went quiet, False on timeout — callers proceed to a hard
+        shutdown either way."""
+        self._accepting = False
+        deadline = time.monotonic() + timeout_s
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cv.wait(remaining)
+        return True
+
+    def _inflight_inc(self):
+        with self._inflight_cv:
+            self._inflight += 1
+
+    def _inflight_dec(self):
+        with self._inflight_cv:
+            self._inflight -= 1
+            self._inflight_cv.notify_all()
 
     def shutdown(self):
         self._stop.set()
@@ -139,6 +183,15 @@ class _PoolServer:
                         conn, _ = self.lsock.accept()
                     except OSError:
                         continue
+                    if not self._accepting:
+                        # draining: refuse new connections immediately so
+                        # clients fail over instead of queueing behind a
+                        # server that is on its way out
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        continue
                     conn.setsockopt(
                         socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
                     )
@@ -164,6 +217,7 @@ class _PoolServer:
                             self._close_conn(conn)
                 else:  # a parked connection has a request pending
                     self._sel.unregister(key.fileobj)
+                    self._inflight_inc()
                     self._jobs.put(key.fileobj)
 
     # -- worker threads ------------------------------------------------------
@@ -186,23 +240,26 @@ class _PoolServer:
             job = self._coord_jobs.get()
             if job is None:
                 return
-            conn, op, args = job
+            conn, op, args, deadline = job
             try:
-                disposition = self._respond(conn, op, args)
+                disposition = self._respond(conn, op, args, deadline)
             except Exception:
                 disposition = "close"
             self._finish(conn, disposition)
 
     def _finish(self, conn, disposition: str):
         if disposition == "park":
+            self._inflight_dec()
             self._park.put(conn)
             try:
                 self._wake_w.send(b"x")
             except OSError:
                 pass
         elif disposition == "close":
+            self._inflight_dec()
             self._close_conn(conn)
-        # "detached": the coordinator pool owns the connection now
+        # "detached": the coordinator pool owns the connection (and the
+        # in-flight count) now
 
     def _serve_one(self, sock: socket.socket) -> str:
         try:
@@ -212,22 +269,96 @@ class _PoolServer:
         if payload is None:
             return "close"
         op, args = wire.decode(payload)
+        # deadline envelope: the client shipped its REMAINING budget in
+        # relative ms (clocks are never compared); anchor it here, at
+        # frame receipt, so queueing delay inside this server counts
+        op, budget_ms = wire.unwrap_deadline(op)
+        deadline = (
+            time.monotonic() + budget_ms / 1e3
+            if budget_ms is not None
+            else None
+        )
         if self.service.is_coordinator(op):
-            self._coord_jobs.put((sock, op, args))
+            self._coord_jobs.put((sock, op, args, deadline))
             return "detached"
-        return self._respond(sock, op, args)
+        return self._respond(sock, op, args, deadline)
 
-    def _respond(self, sock: socket.socket, op, args) -> str:
+    def _respond(self, sock: socket.socket, op, args, deadline=None) -> str:
+        # already-expired work is rejected with a typed err frame BEFORE
+        # dispatch: the client gave up waiting, so the answer would only
+        # burn a worker the live requests need
+        if deadline is not None and time.monotonic() > deadline:
+            return self._send(
+                sock,
+                wire.encode(
+                    "err",
+                    [f"DeadlineExceeded: {op!r} expired before dispatch"],
+                ),
+            )
+        plan = chaos.active_plan()
+        corrupt = truncate = False
+        if plan is not None:
+            decisions = plan.decisions(
+                "server", op, shard=getattr(self.service, "shard", None)
+            )
+            for d in decisions:
+                if d.kind == "delay":
+                    time.sleep(d.delay_s)
+                elif d.kind == "err":
+                    return self._send(sock, wire.encode("err", [d.message]))
+                elif d.kind == "eof":
+                    return "close"
+                elif d.kind == "reset":
+                    self._rst(sock)
+                    return "close"
+                elif d.kind == "blackhole":
+                    time.sleep(d.hold_s)
+                    return "close"
+                elif d.kind == "corrupt":
+                    corrupt = True
+                elif d.kind == "truncate":
+                    truncate = True
+        _REQUEST.deadline = deadline
         try:
             result = self.service.dispatch(op, args)
             frame = wire.encode("ok", result)
-        except Exception as e:  # report, keep serving
+        except Exception as e:  # report (typed by class name), keep serving
             frame = wire.encode("err", [f"{type(e).__name__}: {e}"])
+        finally:
+            _REQUEST.deadline = None
+        if truncate:
+            # torn frame: correct length prefix, then the stream dies
+            try:
+                sock.sendall(frame[: max(5, len(frame) // 2)])
+            except (ConnectionError, OSError):
+                pass
+            return "close"
+        if corrupt:
+            # well-framed garbage: length prefix intact, payload flipped
+            buf = bytearray(frame)
+            for i in range(4, len(buf), max(1, len(buf) // 8)):
+                buf[i] ^= 0xFF
+            frame = bytes(buf)
+        return self._send(sock, frame)
+
+    def _send(self, sock: socket.socket, frame: bytes) -> str:
         try:
             wire.send_frame(sock, frame)
         except (ConnectionError, OSError):
             return "close"
         return "park"
+
+    @staticmethod
+    def _rst(sock: socket.socket) -> None:
+        """Arrange for close() to RST instead of FIN (SO_LINGER 0)."""
+        try:
+            sock.setsockopt(
+                socket.SOL_SOCKET,
+                socket.SO_LINGER,
+                struct.pack("ii", 1, 0),
+            )
+        except OSError:
+            pass
 
 
 class GraphService:
@@ -270,9 +401,15 @@ class GraphService:
             )
         return self
 
-    def stop(self):
+    def stop(self, drain_s: float | None = None):
+        """Shut down; with drain_s, gracefully: deregister from the
+        registry FIRST (clients stop routing here), refuse new
+        connections, finish in-flight work (bounded by drain_s), then
+        close. drain_s=None keeps the immediate-stop behavior."""
         if self._beat is not None:
             self._beat.set()
+        if drain_s:
+            self.server.drain(drain_s)
         self.server.shutdown()
         self.server.server_close()
 
@@ -659,10 +796,24 @@ def main(argv=None):
         native=False if args.no_native else None,
     )
     print(f"serving shard {args.shard} on {svc.host}:{svc.port}", flush=True)
+
+    # SIGTERM (orchestrator-initiated shutdown) drains: deregister, stop
+    # accepting, finish in-flight work, then exit — clients fail over to
+    # the surviving replicas instead of seeing torn responses
+    import signal
+
+    drain_s = float(os.environ.get("EULER_TPU_DRAIN_S", 5.0))
+    done = threading.Event()
+
+    def _term(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
     try:
-        threading.Event().wait()
+        done.wait()
+        svc.stop(drain_s=drain_s)
     except KeyboardInterrupt:
-        svc.stop()
+        svc.stop(drain_s=drain_s)
 
 
 if __name__ == "__main__":

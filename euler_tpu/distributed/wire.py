@@ -15,6 +15,16 @@ Value:   [u8 tag] + tag-specific body
   4 none:  —
   5 bool:  [u8]
   6 list of values: [u16 n][value...]
+
+Deadline propagation rides the op string, not the frame layout: a call
+with a time budget ships op "@dl:<remaining_ms>:<op>" (see
+`wrap_deadline`/`unwrap_deadline`). The budget is RELATIVE milliseconds —
+client and server clocks are never compared — and servers reject
+already-expired work with a typed err frame before dispatch. A pre-PR-4
+server answers the envelope with "unknown op '@dl:...'", which clients
+treat as a degrade signal: drop the envelope for that shard and resend
+(deadlines then only bound the client side). Frame layout is untouched,
+so every other verb stays byte-compatible in both directions.
 """
 
 from __future__ import annotations
@@ -27,6 +37,21 @@ import numpy as np
 from euler_tpu.graph.format import _CODE_DTYPES, _DTYPE_CODES
 
 MAX_FRAME = 1 << 31
+
+DEADLINE_PREFIX = "@dl:"
+
+
+def wrap_deadline(op: str, budget_ms: float) -> str:
+    """Envelope `op` with a remaining-time budget in milliseconds."""
+    return f"{DEADLINE_PREFIX}{budget_ms:.1f}:{op}"
+
+
+def unwrap_deadline(op: str) -> tuple[str, float | None]:
+    """(inner op, remaining budget ms) — (op, None) when no envelope."""
+    if not op.startswith(DEADLINE_PREFIX):
+        return op, None
+    _, ms, inner = op.split(":", 2)
+    return inner, float(ms)
 
 
 def _pack_value(buf: bytearray, v) -> None:
@@ -116,6 +141,19 @@ def encode(op: str, values) -> bytes:
 
 
 def decode(payload: bytes) -> tuple[str, list]:
+    # any malformed payload (truncated, corrupted, garbage) surfaces as
+    # ValueError — ONE exception type for "this frame is broken", which
+    # clients treat as a transport fault (failover) and servers as a
+    # connection-costing error, never a hang or a dead worker
+    try:
+        return _decode(payload)
+    except ValueError:
+        raise
+    except (struct.error, IndexError, UnicodeDecodeError, KeyError) as e:
+        raise ValueError(f"malformed frame: {type(e).__name__}: {e}") from e
+
+
+def _decode(payload: bytes) -> tuple[str, list]:
     view = memoryview(payload)
     (op_len,) = struct.unpack_from("<H", view, 0)
     off = 2

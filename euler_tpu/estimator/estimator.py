@@ -855,8 +855,47 @@ def stack_batches(batch_fn: Callable[[], tuple], k: int) -> Callable[[], tuple]:
 # ---- batch sources (Node/Edge estimator input_fn parity) ----------------
 
 
+def _shard_failure_wrap(fn, on_shard_failure: str, max_skips: int):
+    """Shard-failure policy for training readers: "raise" (default)
+    surfaces the typed error; "skip" drops the failed BATCH and draws the
+    next one, so a dead shard degrades epoch throughput (batches routed
+    to surviving coordinators keep flowing) instead of killing the run.
+    Bounded: more than `max_skips` CONSECUTIVE failures re-raises — a
+    fully dead cluster must not spin forever. `wrapped.skipped` counts
+    dropped batches (telemetry: proves degradation was visible, not
+    silent)."""
+    if on_shard_failure not in ("raise", "skip"):
+        raise ValueError(f"on_shard_failure: {on_shard_failure!r}")
+    if on_shard_failure == "raise":
+        return fn
+
+    from euler_tpu.distributed.errors import RpcError
+
+    def wrapped():
+        skips = 0
+        while True:
+            try:
+                return fn()
+            except RpcError as e:
+                wrapped.skipped += 1
+                skips += 1
+                if skips > max_skips:
+                    raise RpcError(
+                        f"skip-batch policy gave up after {skips}"
+                        f" consecutive failures: {e}"
+                    ) from e
+
+    wrapped.skipped = 0
+    return wrapped
+
+
 def pipelined_batches(
-    flow, batch_size: int, depth: int = 4, node_type: int = -1
+    flow,
+    batch_size: int,
+    depth: int = 4,
+    node_type: int = -1,
+    on_shard_failure: str = "raise",
+    max_skips: int = 16,
 ) -> Callable[[], tuple]:
     """Remote batch source with `depth` overlapped sage_minibatch RPCs.
 
@@ -898,21 +937,29 @@ def pipelined_batches(
                 pending.clear()
                 return (flow.minibatch(batch_size, node_type),)
 
-    return fn
+    return _shard_failure_wrap(fn, on_shard_failure, max_skips)
 
 
 def node_batches(
-    graph, flow, batch_size: int, node_type: int = -1, rng=None
+    graph,
+    flow,
+    batch_size: int,
+    node_type: int = -1,
+    rng=None,
+    on_shard_failure: str = "raise",
+    max_skips: int = 16,
 ) -> Callable[[], tuple]:
     """Training source: sample root nodes per step
-    (node_estimator.py:31-37)."""
+    (node_estimator.py:31-37). on_shard_failure="skip" drops batches that
+    die on a failed shard instead of killing the epoch (bounded; see
+    _shard_failure_wrap)."""
     rng = rng if rng is not None else np.random.default_rng()
 
     def fn():
         roots = graph.sample_node(batch_size, node_type, rng=rng)
         return (flow.query(roots),)
 
-    return fn
+    return _shard_failure_wrap(fn, on_shard_failure, max_skips)
 
 
 def edge_batches(

@@ -26,13 +26,14 @@ import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
-
-class OverloadError(RuntimeError):
-    """Bounded queue full — request refused at admission."""
-
-
-class DeadlineExceededError(RuntimeError):
-    """Request deadline expired before a device slot reached it."""
+# the serving fast-fail verdicts are the distributed layer's typed errors
+# (RpcError subclasses): they cross the wire as an err-frame name prefix
+# and are exempt from transport retry at EVERY client, graph or serving
+from euler_tpu.distributed.errors import (  # noqa: F401 (re-exports)
+    DeadlineExceeded,
+    DeadlineExceededError,
+    OverloadError,
+)
 
 
 @dataclass

@@ -15,22 +15,12 @@ import json
 
 import numpy as np
 
-from euler_tpu.distributed.client import RemoteShard, RpcError
-from euler_tpu.serving.batcher import DeadlineExceededError, OverloadError
-
-_TYPED_ERRORS = {
-    "OverloadError": OverloadError,
-    "DeadlineExceededError": DeadlineExceededError,
-}
-
-
-def _raise_typed(err: RpcError):
-    msg = str(err)
-    name = msg.split(":", 1)[0].strip()
-    cls = _TYPED_ERRORS.get(name)
-    if cls is not None:
-        raise cls(msg.split(":", 1)[1].strip()) from None
-    raise err
+from euler_tpu.distributed.client import RemoteShard
+from euler_tpu.distributed.errors import RpcError  # noqa: F401 (re-export)
+from euler_tpu.serving.batcher import (  # noqa: F401 (re-exports)
+    DeadlineExceededError,
+    OverloadError,
+)
 
 
 class ServingClient:
@@ -58,10 +48,10 @@ class ServingClient:
         return self._pool.rpc_count
 
     def _call(self, op: str, values: list) -> list:
-        try:
-            return self._pool.call(op, values)
-        except RpcError as e:
-            _raise_typed(e)
+        # err frames already come back typed (errors.from_wire in the
+        # transport): OverloadError / DeadlineExceeded are RpcError
+        # subclasses, raised as themselves and never transport-retried
+        return self._pool.call(op, values)
 
     # -- surface ---------------------------------------------------------
 

@@ -14,10 +14,11 @@ Verbs:
   ping         []                                   → [0]
 
 Overload and deadline rejections ride the existing "err" status frame
-with a typed prefix ("OverloadError: ...", "DeadlineExceededError: ...")
-so ServingClient re-raises the typed exception instead of a generic
-RpcError — and never failover-retries either (they are deterministic
-server decisions, not transport faults).
+with a typed prefix ("OverloadError: ...", "DeadlineExceeded: ...") so
+clients raise the typed exception instead of a generic RpcError — and
+never failover-retry either (they are deterministic server decisions,
+not transport faults). Requests without an explicit predict deadline
+inherit the wire-envelope budget every verb now carries.
 """
 
 from __future__ import annotations
@@ -79,9 +80,13 @@ class ModelServer:
             )
         return self
 
-    def stop(self):
+    def stop(self, drain_s: float | None = None):
+        """Shut down; with drain_s, gracefully: deregister, refuse new
+        connections, finish in-flight predicts (bounded), then close."""
         if self._beat is not None:
             self._beat.set()
+        if drain_s:
+            self.server.drain(drain_s)
         self.server.shutdown()
         self.server.server_close()
         self.batcher.close()
@@ -106,6 +111,12 @@ class ModelServer:
                 if deadline_ms
                 else None
             )
+            if deadline is None:
+                # no explicit predict deadline: the wire-envelope budget
+                # (every verb carries one now) bounds the batcher wait too
+                from euler_tpu.distributed.service import current_deadline
+
+                deadline = current_deadline()
             # admission control raises OverloadError HERE (fast-fail);
             # otherwise the worker blocks on the future while the batcher
             # coalesces it with the other in-flight workers' requests

@@ -48,6 +48,27 @@ class MixedWrites:
         self.generation = 0
 
 
+class QuarantineRace:
+    """The pre-PR-4 RemoteShard form: the picker scans replica quarantine
+    timestamps under the pool lock, but the failure path writes them
+    lock-free — the locked scan can observe a torn update."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replicas = []
+
+    def pick(self):
+        with self._lock:
+            for r in self.replicas:
+                if r.bad_until <= 0:
+                    return r
+            return self.replicas[0]
+
+    def on_failure(self, replica):
+        # lock-unguarded-write: pick() reads bad_until under self._lock
+        replica.bad_until = 5.0
+
+
 class LazyOnConcurrentClass:
     """A class that owns a lock declares itself concurrent — unlocked
     lazy init of shared state is check-then-act."""

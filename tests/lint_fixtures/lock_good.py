@@ -47,6 +47,26 @@ class ConsistentWrites:
             self.generation = 0
 
 
+class QuarantineFixed:
+    """The PR-4 RemoteShard form: quarantine writes happen under the same
+    lock the picker scans under."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replicas = []
+
+    def pick(self):
+        with self._lock:
+            for r in self.replicas:
+                if r.bad_until <= 0:
+                    return r
+            return self.replicas[0]
+
+    def on_failure(self, replica):
+        with self._lock:
+            replica.bad_until = 5.0
+
+
 def thread_confined():
     # attributes of threading.local() are per-thread — lazy init is fine
     if getattr(_TLS, "buf", None) is None:

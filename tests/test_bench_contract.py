@@ -48,3 +48,18 @@ def test_bench_smoke_emits_final_json_line():
     # the micro-batcher must actually coalesce under 8 concurrent clients
     assert 0 < srow["batches_per_100_requests"] < 100
     assert row["serving_requests_per_sec"] == srow["value"]
+    # the recovery lane rode along too: seeded replica kill, failover
+    # proven by retry telemetry, deadline plumbing overhead recorded
+    recovery = [
+        json.loads(ln)
+        for ln in json_lines
+        if json.loads(ln).get("metric")
+        == "rpc_recovery_time_to_first_batch_ms"
+    ]
+    assert recovery, json_lines
+    rrow = recovery[-1]
+    assert rrow["value"] > 0 and rrow["unit"] == "ms"
+    assert rrow["failover_retries"] > 0
+    assert rrow["per_batch_ms"] > 0
+    assert "deadline_wire_overhead_pct" in rrow
+    assert row["recovery_ttfb_ms"] == rrow["value"]

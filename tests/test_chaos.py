@@ -1,0 +1,484 @@
+"""Chaos-hardened RPC substrate: recovery determinism under seeded faults.
+
+The failure semantics the OPERATIONS.md table promises, machine-checked:
+  - replica death mid-run fails over BIT-IDENTICALLY to the fault-free
+    run (per-call client-drawn seeds make retried calls replayable), and
+    rpc_count/retry telemetry proves failover happened (not silent skip)
+  - typed errors (RpcError / DeadlineExceeded / OverloadError) are never
+    transport-retried
+  - torn / corrupted response frames trigger failover, not hangs
+  - a fully blackholed shard surfaces a typed error WITHIN the configured
+    deadline — never the old unbounded immediate-retry loop
+  - server drain finishes in-flight work and refuses new connections
+  - deadline budgets propagate on the wire; servers reject expired work
+    before dispatch; pre-envelope peers degrade gracefully
+
+Everything is driven by seeded `FaultPlan`s (distributed/chaos.py), so
+each failure mode is reproducible test input.
+"""
+
+import socket as socket_mod
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.distributed import (
+    Fault,
+    FaultPlan,
+    RetryPolicy,
+    chaos,
+    connect,
+    serve_shard,
+)
+from euler_tpu.distributed.client import RemoteShard, _DaemonExecutor, _Replica
+from euler_tpu.distributed.errors import (
+    DeadlineExceeded,
+    OverloadError,
+    RpcError,
+    from_wire,
+)
+from euler_tpu.graph import convert_json
+
+IDS = np.arange(1, 7, dtype=np.uint64)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """Every test starts and ends chaos-free."""
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+@pytest.fixture(scope="module")
+def ha_cluster(tmp_path_factory, fixture_graph_dict):
+    """2 shards x 2 replicas — enough redundancy to kill one replica per
+    shard and still serve everything."""
+    d = tmp_path_factory.mktemp("chaos")
+    data = str(d / "data")
+    convert_json(fixture_graph_dict, data, num_partitions=2)
+    reg = str(d / "reg")
+    services = [
+        serve_shard(data, s, registry_path=reg, native=False)
+        for s in (0, 1)
+        for _ in range(2)
+    ]
+    remote = connect(registry_path=reg, num_shards=2)
+    yield remote, services, data
+    for s in services:
+        s.stop()
+
+
+def _training_losses(remote, steps, tmp_path, tag):
+    """Short deterministic training loop against the cluster."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.nn import SuperviseModel
+
+    rng = np.random.default_rng(7)
+    flow = SageDataFlow(
+        remote, ["dense2"], fanouts=[2], label_feature="dense3", rng=rng
+    )
+    est = Estimator(
+        SuperviseModel(conv="sage", dims=[8], label_dim=3),
+        node_batches(remote, flow, 4, rng=rng),
+        EstimatorConfig(
+            model_dir=str(tmp_path / tag), total_steps=steps, log_steps=10**9
+        ),
+    )
+    return est.train(log=False, save=False)
+
+
+def test_replica_kill_failover_bit_identical(
+    tmp_path_factory, fixture_graph_dict, tmp_path
+):
+    """Kill one replica per shard mid-run (seeded FaultPlan): the loop
+    completes with results BIT-IDENTICAL to the fault-free run, and the
+    retry telemetry proves recovery was failover, not skipping."""
+    d = tmp_path_factory.mktemp("killrun")
+    data = str(d / "data")
+    convert_json(fixture_graph_dict, data, num_partitions=2)
+    reg = str(d / "reg")
+    services = [
+        serve_shard(data, s, registry_path=reg, native=False)
+        for s in (0, 1)
+        for _ in range(2)
+    ]
+    try:
+        def run(plan):
+            chaos.install(plan)
+            try:
+                remote = connect(registry_path=reg, num_shards=2)
+                losses = _training_losses(
+                    remote, 6, tmp_path, f"m{plan is not None}"
+                )
+                rpcs = sum(sh.rpc_count for sh in remote.shards)
+                retries = sum(sh.retry_count for sh in remote.shards)
+                return losses, rpcs, retries
+            finally:
+                chaos.uninstall()
+
+        losses_ok, rpcs_ok, retries_ok = run(None)
+        assert retries_ok == 0
+
+        # from the 4th call onward, each shard's FIRST replica is dead
+        # (connection reset on every touch — a killed process, minus the
+        # nondeterminism of actually killing one)
+        plan = FaultPlan(
+            [
+                Fault(
+                    site="client",
+                    kind="reset",
+                    shard=s,
+                    replica=(svc.host, svc.port),
+                    after=3,
+                )
+                for s, svc in ((0, services[0]), (1, services[2]))
+            ],
+            seed=11,
+        )
+        losses_chaos, rpcs_chaos, retries_chaos = run(plan)
+
+        np.testing.assert_array_equal(losses_ok, losses_chaos)
+        # same logical call stream, and real failovers happened
+        assert rpcs_chaos == rpcs_ok
+        assert retries_chaos > 0
+    finally:
+        for s in services:
+            s.stop()
+
+
+def test_typed_errors_never_transport_retried(ha_cluster):
+    """A typed err frame must cost exactly ONE server dispatch and zero
+    transport retries — retrying a deterministic verdict just recomputes
+    it (and amplifies overload)."""
+    remote, services, _ = ha_cluster
+    sh = remote.shards[0]
+    for message, exc in [
+        ("OverloadError: injected", OverloadError),
+        ("DeadlineExceeded: injected", DeadlineExceeded),
+        ("RpcError: injected", RpcError),
+    ]:
+        before_retries = sh.retry_count
+        counts_before = [
+            svc.op_counts.get("lookup", 0) for svc in services[:2]
+        ]
+        chaos.install(
+            FaultPlan(
+                [Fault(site="server", kind="err", op="lookup",
+                       message=message)]
+            )
+        )
+        try:
+            with pytest.raises(exc):
+                sh.lookup(IDS)
+        finally:
+            chaos.uninstall()
+        counts_after = [
+            svc.op_counts.get("lookup", 0) for svc in services[:2]
+        ]
+        # the err fault fires BEFORE dispatch, so op_counts must not move
+        # at all — and the client must not have touched a second replica
+        assert counts_after == counts_before
+        assert sh.retry_count == before_retries, message
+
+
+def test_torn_and_corrupt_frames_failover_not_hang(ha_cluster):
+    """A truncated or bit-flipped response frame is a transport fault:
+    the client drops the connection, quarantines, and fails over —
+    bounded by the deadline, never a hang."""
+    remote, _, _ = ha_cluster
+    sh = remote.shards[0]
+    expected = sh.lookup(IDS)
+    for kind in ("truncate", "corrupt"):
+        before = sh.retry_count
+        chaos.install(
+            FaultPlan(
+                [Fault(site="server", kind=kind, op="lookup", count=1)]
+            )
+        )
+        try:
+            t0 = time.monotonic()
+            out = sh.lookup(IDS)
+            elapsed = time.monotonic() - t0
+        finally:
+            chaos.uninstall()
+        np.testing.assert_array_equal(out, expected)
+        assert sh.retry_count == before + 1, kind
+        assert elapsed < 10.0, kind
+
+
+def test_all_replicas_blackholed_typed_error_within_deadline(ha_cluster):
+    """Every replica of a shard silent: the client must surface a typed
+    DeadlineExceeded within the configured budget — not spin in the old
+    unbounded immediate-retry loop."""
+    remote, _, _ = ha_cluster
+    sh = remote.shards[1]
+    chaos.install(
+        FaultPlan([Fault(site="client", kind="blackhole", shard=1)])
+    )
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        sh.call("ping", [], deadline_s=1.0)
+    elapsed = time.monotonic() - t0
+    assert 0.9 <= elapsed < 3.0, elapsed
+
+
+def test_retry_budget_stops_retry_storms(ha_cluster, monkeypatch):
+    """With the token bucket dry, a systematically failing shard fails
+    FAST (typed error) instead of hammering dead replicas 10x per call."""
+    remote, _, data = ha_cluster
+    sh = RemoteShard(0, [("127.0.0.1", 1)])  # nothing listens on port 1
+    sh._budget.cap = 2.0
+    sh._budget._tokens = 2.0
+    with pytest.raises(RpcError, match="retry budget exhausted"):
+        sh.call("ping", [], deadline_s=5.0)
+    # budget refills on success elsewhere — the bucket is per shard
+    assert remote.shards[0].ping() == 0
+
+
+def test_server_rejects_expired_work_before_dispatch(ha_cluster):
+    """A request whose wire budget is already spent gets a typed err
+    frame without costing a dispatch."""
+    remote, services, _ = ha_cluster
+    r = remote.shards[0].replicas[0]
+    svc = next(s for s in services if s.port == r.port)
+    before = dict(svc.op_counts)
+    with pytest.raises(DeadlineExceeded, match="expired before dispatch"):
+        r.call("lookup", [IDS], timeout_s=5.0, budget_ms=-5.0)
+    assert svc.op_counts.get("lookup", 0) == before.get("lookup", 0)
+
+
+def test_deadline_envelope_degrades_for_old_servers(monkeypatch):
+    """A peer predating the envelope answers it with unknown-op: the
+    client must go sticky-plain and resend — one logical call, no
+    transport retry, correct result."""
+    calls = []
+
+    def fake_call(self, op, values, timeout_s=None, budget_ms=None):
+        calls.append((op, budget_ms))
+        if budget_ms is not None:
+            raise RpcError(
+                f"ValueError: unknown op '@dl:{budget_ms:.1f}:{op}'"
+            )
+        return [41]
+
+    monkeypatch.setattr(_Replica, "call", fake_call)
+    sh = RemoteShard(0, [("127.0.0.1", 1)])
+    assert sh.call("ping", []) == [41]
+    assert sh._deadline_wire is False
+    assert [op for op, _ in calls] == ["ping", "ping"]
+    assert calls[0][1] is not None and calls[1][1] is None
+    assert sh.retry_count == 0 and sh.rpc_count == 1
+    # sticky: the next call never tries the envelope again
+    assert sh.call("ping", []) == [41]
+    assert calls[-1][1] is None
+
+
+def test_server_drain_completes_inflight_work(tmp_path, fixture_graph_dict):
+    """stop(drain_s=...) finishes requests already executing, refuses new
+    connections, and deregisters — clients fail over instead of seeing
+    torn responses."""
+    data = str(tmp_path / "data")
+    convert_json(fixture_graph_dict, data, num_partitions=1)
+    svc = serve_shard(data, 0, native=False)
+    sh = RemoteShard(0, [("127.0.0.1", svc.port)])
+    chaos.install(
+        FaultPlan(
+            [Fault(site="server", kind="delay", op="lookup", delay_s=0.6)]
+        )
+    )
+    result = {}
+
+    def slow_lookup():
+        result["rows"] = sh.lookup(IDS)
+
+    t = threading.Thread(target=slow_lookup, daemon=True)
+    t.start()
+    time.sleep(0.2)  # the lookup is now executing inside a worker
+    svc.stop(drain_s=10.0)
+    t.join(timeout=10)
+    chaos.uninstall()
+    assert not t.is_alive()
+    assert result["rows"].shape == (6,)  # in-flight work completed
+    # and the listener is gone: a fresh connection is refused
+    with pytest.raises(OSError):
+        socket_mod.create_connection(("127.0.0.1", svc.port), timeout=2.0)
+
+
+def test_connect_falls_through_dead_shard0(ha_cluster, monkeypatch):
+    """get_meta must fall through to later shards when every replica of
+    shard 0 is unreachable — bring-up order can't wedge the client."""
+    remote, services, _ = ha_cluster
+    monkeypatch.setenv("EULER_TPU_RPC_TIMEOUT_S", "1.0")
+    monkeypatch.setenv("EULER_TPU_RPC_RETRIES", "2")
+    cluster = {
+        0: [("127.0.0.1", 1)],  # nothing listens here
+        1: [("127.0.0.1", services[2].port)],
+    }
+    g = connect(cluster=cluster)
+    assert g.num_shards == 2
+    assert g.shards[1].ping() == 1
+    # and when EVERY shard is dead, the error says so
+    with pytest.raises(RpcError, match="every shard"):
+        connect(cluster={0: [("127.0.0.1", 1)], 1: [("127.0.0.1", 1)]})
+
+
+def test_daemon_executor_close_cancels_pending():
+    """close() must resolve queued-but-unstarted futures (cancelled), not
+    leave their waiters hanging forever behind the sentinel."""
+    import concurrent.futures
+
+    ex = _DaemonExecutor(1, "t")
+    gate = threading.Event()
+    running = threading.Event()
+
+    def block():
+        running.set()
+        gate.wait(10)
+        return "done"
+
+    f1 = ex.submit(block)
+    assert running.wait(5)
+    f2 = ex.submit(lambda: "never-started")
+    ex.close()
+    with pytest.raises(concurrent.futures.CancelledError):
+        f2.result(timeout=5)
+    gate.set()
+    assert f1.result(timeout=5) == "done"  # in-flight work still finishes
+
+
+def test_skip_batch_policy_degrades_not_dies(ha_cluster):
+    """on_shard_failure="skip": batches that die on a failing shard are
+    dropped (counted) and the epoch continues on the survivors; the
+    default policy still raises."""
+    from euler_tpu.dataflow import SageDataFlow
+    from euler_tpu.estimator import pipelined_batches
+
+    remote, _, _ = ha_cluster
+    # shard 0's servers refuse every minibatch; shard 1 keeps serving
+    plan = FaultPlan(
+        [Fault(site="server", kind="err", op="sage_minibatch", shard=0)]
+    )
+
+    def make_src(policy):
+        flow = SageDataFlow(
+            remote, ["dense2"], fanouts=[2], label_feature="dense3",
+            rng=np.random.default_rng(3), feature_mode="rows", lean=True,
+        )
+        return pipelined_batches(
+            flow, batch_size=4, depth=2, on_shard_failure=policy
+        )
+
+    chaos.install(plan)
+    try:
+        src = make_src("skip")
+        batches = [src() for _ in range(6)]
+        assert all(b[0].labels is not None for b in batches)
+        assert src.skipped > 0  # degradation was visible, not silent
+        with pytest.raises(RpcError):
+            raising = make_src("raise")
+            for _ in range(12):  # the coordinator draw hits shard 0 soon
+                raising()
+    finally:
+        chaos.uninstall()
+
+
+def test_backoff_schedule_deterministic():
+    """Same seed -> same jittered backoff schedule; different seeds
+    diverge. Recovery timing is replayable test input."""
+    def schedule(seed):
+        p = RetryPolicy(seed=seed)
+        rng = p.call_rng()
+        return [p.backoff_s(a, rng) for a in range(6)]
+
+    assert schedule(5) == schedule(5)
+    assert schedule(5) != schedule(6)
+    s = schedule(5)
+    assert all(b > 0 for b in s)
+    assert max(s) <= 2.0  # capped
+
+
+def test_fault_plan_seeded_probability_deterministic():
+    """prob<1 firings replay exactly for the same plan seed."""
+    def pattern(seed):
+        plan = FaultPlan(
+            [Fault(site="client", kind="delay", prob=0.5, delay_s=0.0)],
+            seed=seed,
+        )
+        return [
+            bool(plan.decisions("client", "ping", shard=0, replica=("h", 1)))
+            for _ in range(32)
+        ]
+
+    assert pattern(3) == pattern(3)
+    assert any(pattern(3)) and not all(pattern(3))
+    assert pattern(3) != pattern(4)
+
+
+def test_chaos_env_spec(monkeypatch):
+    """EULER_TPU_CHAOS drives any process programmatic access can't reach
+    (spawned shard servers): the JSON spec parses, matches, and fires."""
+    monkeypatch.setenv(
+        "EULER_TPU_CHAOS",
+        '{"seed": 7, "faults": [{"site": "server", "kind": "delay",'
+        ' "op": "ping", "delay_s": 0.0}]}',
+    )
+    plan = chaos.active_plan()
+    assert plan is not None
+    assert plan.decisions("server", "ping", shard=0)
+    assert not plan.decisions("server", "lookup", shard=0)
+    assert plan.stats()[0]["fired"] == 1
+    monkeypatch.delenv("EULER_TPU_CHAOS")
+    assert chaos.active_plan() is None
+
+
+def test_from_wire_mapping():
+    assert isinstance(from_wire("DeadlineExceeded: x"), DeadlineExceeded)
+    assert isinstance(from_wire("DeadlineExceededError: x"), DeadlineExceeded)
+    assert isinstance(from_wire("OverloadError: x"), OverloadError)
+    assert type(from_wire("KeyError: 'nope'")) is RpcError
+    assert type(from_wire("no-colon garbage")) is RpcError
+
+
+def test_bad_fault_spec_rejected():
+    with pytest.raises(ValueError, match="bad client fault kind"):
+        Fault(site="client", kind="corrupt")
+    with pytest.raises(ValueError, match="bad fault site"):
+        Fault(site="everywhere", kind="delay")
+
+
+@pytest.mark.slow
+def test_soak_random_faults_all_calls_resolve(ha_cluster):
+    """Long soak: a seeded storm of resets/delays/corruption — every call
+    either succeeds or raises typed, and the cluster stays serviceable."""
+    remote, _, _ = ha_cluster
+    sh = remote.shards[0]
+    expected = sh.lookup(IDS)
+    plan = FaultPlan(
+        [
+            Fault(site="client", kind="reset", prob=0.15),
+            Fault(site="server", kind="corrupt", prob=0.1),
+            Fault(site="server", kind="delay", prob=0.2, delay_s=0.01),
+        ],
+        seed=42,
+    )
+    chaos.install(plan)
+    try:
+        outcomes = {"ok": 0, "typed": 0}
+        for _ in range(300):
+            try:
+                np.testing.assert_array_equal(
+                    sh.call("lookup", [IDS], deadline_s=10.0)[0], expected
+                )
+                outcomes["ok"] += 1
+            except RpcError:
+                outcomes["typed"] += 1
+    finally:
+        chaos.uninstall()
+    assert outcomes["ok"] > 250, outcomes
+    assert sh.retry_count > 0
+    # chaos off: fully healthy again
+    np.testing.assert_array_equal(sh.lookup(IDS), expected)

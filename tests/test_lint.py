@@ -74,6 +74,8 @@ def test_gate_covers_the_package():
         "euler_tpu/serving/server.py",
         "euler_tpu/distributed/service.py",
         "euler_tpu/distributed/client.py",
+        "euler_tpu/distributed/chaos.py",
+        "euler_tpu/distributed/retry.py",
         "euler_tpu/estimator/feature_cache.py",
         "euler_tpu/estimator/prefetch.py",
         "euler_tpu/query/plan.py",
@@ -111,6 +113,15 @@ def test_lock_discipline_fixture_trips():
     ids = _ids(findings)
     assert ids["lock-racy-init"] == 2, findings
     assert ids["lock-mixed-write"] == 2, findings
+    # the PR-4 regression: quarantine timestamps read under the pool lock
+    # in the picker, written lock-free in the failure path — graftlint
+    # must catch the old RemoteShard.bad_until form
+    assert ids["lock-unguarded-write"] == 1, findings
+    unguarded = next(
+        f for f in findings if f.check == "lock-unguarded-write"
+    )
+    assert "bad_until" in unguarded.message
+    assert unguarded.symbol == "QuarantineRace.on_failure"
     # the regression the ISSUE pins: the pre-PR-2 _jit_cache
     # attribute-injection get-or-build race must be among them
     racy = [f for f in findings if f.check == "lock-racy-init"]
