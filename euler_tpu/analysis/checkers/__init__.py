@@ -5,5 +5,6 @@ from euler_tpu.analysis.checkers import (  # noqa: F401
     determinism,
     jit_purity,
     lock_discipline,
+    unbounded_cache,
     wire_protocol,
 )

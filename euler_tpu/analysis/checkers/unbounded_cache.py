@@ -1,0 +1,209 @@
+"""unbounded-cache: memoization state that only ever grows, in code a
+worker thread can reach.
+
+Check id:
+  unbounded-cache — a dict-like attribute (``self.x = {}`` /
+                    ``dict()`` / ``OrderedDict()`` / ``defaultdict()``)
+                    or module-global dict that is GROWN
+                    (``x[k] = v`` / ``x.setdefault(...)``) inside a
+                    function reachable from a ``threading.Thread`` /
+                    executor-submit target, while the owning scope shows
+                    NO eviction bound anywhere: no ``pop``/``popitem``/
+                    ``clear``, no ``del x[...]``, no ``len(x)`` check,
+                    and no reset-by-rebind outside ``__init__``.
+
+Why thread-reachable only: a request-keyed memo on a worker path is the
+classic slow leak — every distinct key a long-lived server sees stays
+resident forever, and nobody owns the process long enough to notice.
+The same dict on a construction path is usually keyed by a small closed
+domain (edge types, buckets) and dies with its owner.
+
+Deliberately NOT flagged:
+  - ``collections.Counter`` (telemetry, not a cache — op_counts)
+  - ``weakref.WeakKeyDictionary`` / ``WeakValueDictionary`` (self-evicting)
+  - dicts held in locals (they die with the frame)
+
+The bounded good form this checker pushes toward is the client read
+cache (euler_tpu/distributed/cache.py): striped LRU ``OrderedDict``s
+whose inserts evict under a byte budget — ``popitem(last=False)`` is
+exactly the evidence this checker looks for.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.callgraph import CallGraph
+from euler_tpu.analysis.core import Checker, Finding, Module, register
+from euler_tpu.analysis.symbols import dotted
+
+CHECKER = "unbounded-cache"
+
+_INIT_FUNCS = {"__init__", "__new__", "__post_init__"}
+# constructors that create growable dict-like state worth bounding
+_DICT_CTORS = {
+    "dict",
+    "collections.OrderedDict",
+    "OrderedDict",
+    "collections.defaultdict",
+    "defaultdict",
+}
+# growth verbs on a tracked name
+_GROW_METHODS = {"setdefault"}
+# eviction/bounding verbs: any appearance on the tracked name clears it
+_BOUND_METHODS = {"pop", "popitem", "clear"}
+
+
+def _is_dict_ctor(mod: Module, value: ast.AST) -> bool:
+    if isinstance(value, ast.Dict):
+        return True
+    if isinstance(value, ast.DictComp):
+        return True
+    if isinstance(value, ast.Call):
+        canon = mod.symbols.canonical_of(value.func)
+        return canon in _DICT_CTORS or dotted(value.func) in _DICT_CTORS
+    return False
+
+
+class _State:
+    """One tracked dict: where it lives, how it grows, what bounds it."""
+
+    __slots__ = ("decl_line", "grows", "bounded")
+
+    def __init__(self, decl_line: int):
+        self.decl_line = decl_line
+        self.grows: list[tuple[str, int]] = []  # (qualname, line)
+        self.bounded = False
+
+
+def _scan_module(mod: Module) -> list[Finding]:
+    cg = CallGraph(mod.tree, mod.symbols)
+    thread_reach = cg.thread_reachable()
+
+    # -- declarations ----------------------------------------------------
+    # class attr key: "<Cls>.self.x"; module global key: bare name
+    states: dict[str, _State] = {}
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and _is_dict_ctor(mod, stmt.value):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    states[t.id] = _State(stmt.lineno)
+        elif isinstance(stmt, ast.ClassDef):
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not _is_dict_ctor(mod, node.value):
+                    continue
+                for t in node.targets:
+                    d = dotted(t)
+                    if d and d.startswith("self.") and d.count(".") == 1:
+                        states.setdefault(
+                            f"{stmt.name}.{d}", _State(node.lineno)
+                        )
+
+    if not states:
+        return []
+
+    # -- usage scan ------------------------------------------------------
+    def key_of(base: ast.AST, cls: str | None) -> str | None:
+        d = dotted(base)
+        if d is None:
+            return None
+        if d.startswith("self.") and d.count(".") == 1 and cls:
+            k = f"{cls}.{d}"
+            return k if k in states else None
+        return d if d in states else None
+
+    def scan_fn(fn, cls_name: str | None, qual: str):
+        in_init = qual.rpartition(".")[2] in _INIT_FUNCS
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        k = key_of(t.value, cls_name)
+                        if k:
+                            states[k].grows.append((qual, node.lineno))
+                    elif not in_init:
+                        # reset-by-rebind outside __init__ (a clear())
+                        # counts as a bound
+                        d = dotted(t)
+                        if d and cls_name and d.startswith("self."):
+                            k = f"{cls_name}.{d}"
+                            if k in states and _is_dict_ctor(mod, node.value):
+                                states[k].bounded = True
+                        elif d and d in states and _is_dict_ctor(
+                            mod, node.value
+                        ):
+                            states[d].bounded = True
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    k = key_of(f.value, cls_name)
+                    if k:
+                        if f.attr in _GROW_METHODS:
+                            states[k].grows.append((qual, node.lineno))
+                        elif f.attr in _BOUND_METHODS:
+                            states[k].bounded = True
+                elif (
+                    isinstance(f, ast.Name)
+                    and f.id == "len"
+                    and node.args
+                ):
+                    k = key_of(node.args[0], cls_name)
+                    if k:
+                        states[k].bounded = True
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    k = key_of(base, cls_name)
+                    if k:
+                        states[k].bounded = True
+
+    def walk_defs(body, cls_name, prefix):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{stmt.name}"
+                scan_fn(stmt, cls_name, qual)
+                walk_defs(stmt.body, cls_name, f"{qual}.")
+            elif isinstance(stmt, ast.ClassDef):
+                walk_defs(stmt.body, stmt.name, f"{stmt.name}.")
+
+    walk_defs(mod.tree.body, None, "")
+
+    # -- findings --------------------------------------------------------
+    findings: list[Finding] = []
+    for key, st in sorted(states.items()):
+        if st.bounded:
+            continue
+        for qual, line in st.grows:
+            if qual not in thread_reach:
+                continue
+            shown = key.replace(".self.", ".") if ".self." in key else key
+            findings.append(
+                Finding(
+                    CHECKER,
+                    CHECKER,
+                    mod.relpath,
+                    line,
+                    qual,
+                    f"`{shown}` grows here on a thread-reachable path with"
+                    " no eviction bound anywhere in its scope (no pop/"
+                    "popitem/clear/del/len check) — every distinct key a"
+                    " long-lived worker sees stays resident forever. Bound"
+                    " it (LRU eviction under a budget, the"
+                    " distributed/cache.py ReadCache form) or suppress"
+                    " with a reason",
+                )
+            )
+    return findings
+
+
+@register
+class UnboundedCacheChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            out.extend(_scan_module(mod))
+        return out

@@ -65,6 +65,35 @@ class MiniBatch:
     target_idx: Array | None = None
 
 
+def gather_unique(ids_list, fetch):
+    """Cross-hop unique-ID coalescing: ONE deduplicated fetch covers
+    every hop, results scattered back by inverse index.
+
+    A 2-hop SAGE batch re-cites the same hot node in (on power-law
+    graphs) most of its slots, and cites hop-1 nodes again in hop 2 —
+    fetching per hop ships every duplicate id AND its result row L×.
+    `fetch(uniq)` sees each id once; because the fetched verbs are
+    deterministic per id, `fetch(uniq)[inverse]` is bit-identical to
+    fetching each hop directly.
+
+    ids_list: 1-D id (or row) arrays. fetch(uniq) -> array whose leading
+    dim is len(uniq). Returns one array per input list, same leading
+    lengths, remaining dims from the fetch result.
+    """
+    arrs = [np.asarray(a).reshape(-1) for a in ids_list]
+    flat = np.concatenate(arrs) if arrs else np.empty(0, np.uint64)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    vals = np.asarray(fetch(uniq))
+    ndup = int(flat.size - uniq.size)
+    if ndup and len(uniq):
+        from euler_tpu.distributed.cache import note_gather_dedup
+
+        note_gather_dedup(ndup, vals.nbytes // len(uniq))
+    out_flat = vals[inv]
+    offs = np.cumsum([0] + [a.size for a in arrs])
+    return [out_flat[offs[i] : offs[i + 1]] for i in range(len(arrs))]
+
+
 class DataFlow:
     """Base: fetches features/labels; subclasses build the hop structure.
 
@@ -101,6 +130,25 @@ class DataFlow:
         if not self.feature_names:
             return np.zeros((len(ids), 0), dtype=np.float32)
         return self.graph.get_dense_feature(ids, self.feature_names)
+
+    def node_feats_hops(self, ids_list) -> tuple:
+        """Per-hop `node_feats`, with ids deduplicated ACROSS hops before
+        the (possibly remote) fetch — one unique-id round instead of L+1
+        rounds re-shipping every duplicate's feature row. Bit-identical
+        to `tuple(self.node_feats(ids) for ids in ids_list)`."""
+        if self.feature_mode == "rows":
+            def fetch(u):
+                rows = np.asarray(self.graph.lookup_rows(u))
+                return np.where(rows >= 0, rows + 1, 0).astype(np.int32)
+        elif not self.feature_names:
+            return tuple(
+                np.zeros((len(np.asarray(i)), 0), np.float32)
+                for i in ids_list
+            )
+        else:
+            def fetch(u):
+                return self.graph.get_dense_feature(u, self.feature_names)
+        return tuple(gather_unique(ids_list, fetch))
 
     def labels_of(self, ids: np.ndarray) -> np.ndarray | None:
         if self.label_feature is None:

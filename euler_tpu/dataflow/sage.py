@@ -206,8 +206,10 @@ class SageDataFlow(DataFlow):
             cur = nbr.reshape(-1)
             hop_ids.append(cur)
             hop_masks.append(mask.reshape(-1))
-        # padded slots hold DEFAULT_ID → feature fetch returns zeros
-        feats = tuple(self.node_feats(ids) for ids in hop_ids)
+        # padded slots hold DEFAULT_ID → feature fetch returns zeros;
+        # cross-hop dedup: hop 2 re-cites hop 1's hot nodes, so the
+        # unique set — not every duplicate slot — goes to the wire
+        feats = self.node_feats_hops(hop_ids)
         return MiniBatch(
             feats=feats,
             masks=tuple(hop_masks),
@@ -273,23 +275,27 @@ class SageDataFlow(DataFlow):
         ):
             # reuse the rows the fanout already resolved — no second
             # per-id lookup pass (the facade splits global rows back to
-            # their owner shards on partitioned graphs)
+            # their owner shards on partitioned graphs). Rows dedup
+            # across hops before the wire: a hot node's feature row
+            # ships (or cache-misses) once per batch, not once per slot.
+            from euler_tpu.dataflow.base import gather_unique
+
             try:
-                feats = tuple(
-                    self.graph.get_dense_by_rows(r, self.feature_names)
-                    for r in hop_rows
-                )
+                feats = tuple(gather_unique(
+                    hop_rows,
+                    lambda u: self.graph.get_dense_by_rows(
+                        u, self.feature_names
+                    ),
+                ))
             except RuntimeError as e:
                 # capability gap only (older server / no row space):
                 # fall back to per-id fetch; real failures must surface
                 if "unknown op" in str(e) or "num_nodes" in str(e):
-                    feats = tuple(
-                        self.node_feats(ids) for ids in hop_ids
-                    )
+                    feats = self.node_feats_hops(hop_ids)
                 else:
                     raise
         else:
-            feats = tuple(self.node_feats(ids) for ids in hop_ids)
+            feats = self.node_feats_hops(hop_ids)
         return MiniBatch(
             feats=feats,
             masks=None if lean else tuple(hop_masks),
@@ -361,17 +367,21 @@ class FullNeighborDataFlow(DataFlow):
             hop_ids.append(cur)
             hop_masks.append(mask.reshape(-1))
         if self.gcn_norm:
-            degs = [
-                np.asarray(
-                    self.graph.degree_sum(ids, self.edge_types), np.float32
-                )
-                for ids in hop_ids
-            ]
+            # cross-hop dedup: every hop re-cites its parents, so the
+            # true-degree fetch ships each unique id once
+            from euler_tpu.dataflow.base import gather_unique
+
+            degs = gather_unique(
+                hop_ids,
+                lambda u: np.asarray(
+                    self.graph.degree_sum(u, self.edge_types), np.float32
+                ),
+            )
             blocks = [
                 b.replace(dst_deg=degs[h], src_deg=degs[h + 1])
                 for h, b in enumerate(blocks)
             ]
-        feats = tuple(self.node_feats(ids) for ids in hop_ids)
+        feats = self.node_feats_hops(hop_ids)
         return MiniBatch(
             feats=feats,
             masks=tuple(hop_masks),
@@ -393,6 +403,16 @@ class FullNeighborDataFlow(DataFlow):
         )
 
         rows_mode = self.feature_mode == "rows"
+        # fully-cached roots skip the plan's hop-0 feature step: the
+        # server neither gathers nor ships rows the client will fill
+        # from its read cache (bit-identical bytes) below
+        skip_root_feats = False
+        if not rows_mode and self.feature_names:
+            from euler_tpu.distributed.cache import dense_coverage
+
+            skip_root_feats = dense_coverage(
+                self.graph, roots, self.feature_names
+            )
         plan = full_neighbor_plan(
             self.edge_types,
             self.num_hops,
@@ -401,6 +421,7 @@ class FullNeighborDataFlow(DataFlow):
             label=self.label_feature,
             rows=rows_mode,
             degrees=self.gcn_norm,
+            root_features=not skip_root_feats,
         )
         seed = int(self.rng.integers(0, 2**63 - 1))
         res = run_plan(
@@ -432,8 +453,23 @@ class FullNeighborDataFlow(DataFlow):
             )
         elif self.feature_names:
             feats = tuple(
-                res[f"__f{h}"] for h in range(self.num_hops + 1)
+                # hop 0 skipped on the wire → every row is a cache hit
+                self.graph.get_dense_feature(roots, self.feature_names)
+                if (h == 0 and skip_root_feats)
+                else res[f"__f{h}"]
+                for h in range(self.num_hops + 1)
             )
+            # write-back: rows that arrived inside the fused response
+            # seed the read cache so the NEXT plan over these (hot) ids
+            # skips its feature steps and direct fetches hit
+            from euler_tpu.distributed.cache import seed_dense_rows
+
+            for h in range(self.num_hops + 1):
+                if h == 0 and skip_root_feats:
+                    continue  # those rows came FROM the cache
+                seed_dense_rows(
+                    self.graph, hop_ids[h], self.feature_names, feats[h]
+                )
         else:
             feats = tuple(
                 np.zeros((len(ids), 0), np.float32) for ids in hop_ids

@@ -128,8 +128,11 @@ class WholeGraphDataFlow(DataFlow):
                 np.clip(label_ids, 0, len(self.label_class) - 1)
             ]
             labels[np.arange(g), cls] = 1.0
+        # node_feats_hops dedups the flattened table before the fetch —
+        # padding slots (all DEFAULT_ID) and shared nodes cost one row
+        (feats,) = self.node_feats_hops([flat])
         return GraphBatch(
-            feats=self.node_feats(flat),
+            feats=feats,
             node_mask=node_mask,
             block=block,
             graph_ids=np.repeat(np.arange(g, dtype=np.int32), nmax),

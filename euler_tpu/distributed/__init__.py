@@ -1,4 +1,9 @@
 from euler_tpu.distributed.client import RemoteShard, RpcError, connect  # noqa: F401
+from euler_tpu.distributed.cache import (  # noqa: F401
+    ReadCache,
+    clear_graph_caches,
+    graph_cache_stats,
+)
 from euler_tpu.distributed.chaos import Fault, FaultPlan  # noqa: F401
 from euler_tpu.distributed.errors import (  # noqa: F401
     DeadlineExceeded,

@@ -1,0 +1,397 @@
+"""Deterministic client-side read cache for the remote graph path.
+
+Euler 2.0 hides hot-node re-reads behind a client query-proxy cache
+(euler/client/query_proxy.cc); this is that cache for the TPU build's
+wire protocol. Power-law graphs re-touch the same hot nodes every
+batch, so without it every RPC re-ships bytes the client already holds.
+
+Scope discipline — the A/B contract of this repo is that fused, per-op,
+and cached paths are BIT-IDENTICAL under the same seeds — restricts the
+cache to deterministic reads only: `lookup`, `node_type`, dense/sparse/
+binary features, `get_full_neighbor` (fixed cap), `degree_sum`. Seeded
+sampling verbs never touch it.
+
+Shape:
+
+- sharded-lock LRU: N stripes, each its own ``threading.Lock`` +
+  ``OrderedDict`` + byte counter, so concurrent readers on different id
+  ranges never serialize on one lock. Stripe of an id is ``id % N``.
+- entries are PER-ID blocks keyed ``(cache key, id)`` where the cache
+  key is ``(verb, names/args...)``: one row of a dense response, one
+  capped neighbor row set, one degree. Blocks are stored as raw bytes
+  (copied OUT of the wire frame, so a few cached rows never pin a
+  multi-MB borrowed recv buffer) and reassembled with one
+  ``b"".join`` + ``np.frombuffer`` per component — no per-id array
+  stacking on the hot path.
+- negative entries come free: a missing id's block IS the deterministic
+  value the server returns for it (-1 row, zero features, empty
+  neighbor set), so repeated misses of absent ids cost zero RPCs.
+- size-bounded: ``EULER_TPU_READ_CACHE_MB`` (per shard handle) divided
+  across stripes; inserting past the stripe budget evicts LRU entries.
+  A single block bigger than a stripe's budget is simply not cached.
+- staleness: the server's ``stats`` verb carries a ``graph_epoch``
+  field. ``observe_epoch`` invalidates everything on mismatch; servers
+  predating the field report nothing → epoch 0 → cache-forever, which
+  is exactly right for their immutable stores.
+
+Request-side dedup rides the same entry point: ``fetch`` uniques the
+requested ids before probing, fetches only the residual (miss) ids over
+the wire, and scatters hits+fetches back by inverse index — so even a
+fully-cold batch never ships a duplicate id or re-receives its row.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+# fixed per-entry bookkeeping estimate (key tuple, OrderedDict node,
+# bytes objects) added to each block's payload bytes for the budget
+_ENTRY_OVERHEAD = 96
+
+
+def cache_enabled() -> bool:
+    return os.environ.get("EULER_TPU_READ_CACHE", "1") != "0"
+
+
+def cache_budget_bytes() -> int:
+    return int(
+        float(os.environ.get("EULER_TPU_READ_CACHE_MB", "64")) * (1 << 20)
+    )
+
+
+def epoch_refresh_s() -> float:
+    """Seconds between graph_epoch re-polls (0 = check once per shard
+    handle and trust it — the right default for immutable deployments)."""
+    return float(os.environ.get("EULER_TPU_READ_CACHE_EPOCH_S", "0"))
+
+
+class _Stripe:
+    __slots__ = ("lock", "map", "bytes")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.map: OrderedDict = OrderedDict()
+        self.bytes = 0
+
+
+class ReadCache:
+    """Sharded-lock LRU of per-id blocks for deterministic remote reads."""
+
+    def __init__(self, budget_bytes: int, stripes: int = 8):
+        self.budget = max(int(budget_bytes), 1)
+        self._stripes = [_Stripe() for _ in range(max(int(stripes), 1))]
+        self._per_stripe = max(self.budget // len(self._stripes), 1)
+        # per-key component layout: [(np.dtype, per-id shape, nbytes)].
+        # Bounded by the handful of (verb, names) combos a run touches,
+        # so it never needs eviction; guarded by its own lock.
+        self._meta: dict[tuple, list] = {}
+        self._meta_lock = threading.Lock()
+        # epoch transitions (first observation, invalidation) are rare
+        # and must be atomic — one lock, never held during fetches
+        self._epoch_lock = threading.Lock()
+        self.epoch: int | None = None
+        # telemetry counters: GIL-racy increments are fine (same stance
+        # as RemoteShard.rpc_count — they are telemetry, not invariants)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.bytes_saved = 0  # wire bytes a hit avoided re-shipping
+        self.dedup_ids = 0  # duplicate ids removed before the wire
+        self.dedup_bytes_saved = 0  # bytes those duplicates would ship
+
+    @classmethod
+    def from_env(cls) -> "ReadCache | None":
+        return cls(cache_budget_bytes()) if cache_enabled() else None
+
+    # -- epoch / invalidation -------------------------------------------
+
+    def observe_epoch(self, epoch: int) -> None:
+        """Record the server's graph_epoch; a CHANGE flushes everything
+        (mutated graphs must never serve stale bytes). Epoch 0 — old
+        servers without the field — means cache-forever."""
+        epoch = int(epoch)
+        flush = False
+        with self._epoch_lock:
+            if self.epoch is None:
+                self.epoch = epoch
+            elif epoch != self.epoch:
+                self.epoch = epoch
+                self.invalidations += 1
+                flush = True
+        if flush:
+            self.clear()
+
+    def clear(self) -> None:
+        for st in self._stripes:
+            with st.lock:
+                st.map.clear()
+                st.bytes = 0
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        return sum(st.bytes for st in self._stripes)
+
+    def stats(self) -> dict:
+        lookups = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / lookups, 4) if lookups else 0.0,
+            "evictions": self.evictions,
+            "invalidations": self.invalidations,
+            "bytes": self.nbytes,
+            "budget_bytes": self.budget,
+            "bytes_saved": self.bytes_saved,
+            "dedup_ids": self.dedup_ids,
+            "dedup_bytes_saved": self.dedup_bytes_saved,
+            "epoch": self.epoch,
+        }
+
+    # -- core ------------------------------------------------------------
+
+    def _stripe_of(self, uniq: np.ndarray) -> np.ndarray:
+        return (uniq.astype(np.int64, copy=False) % len(self._stripes)).astype(
+            np.int64
+        )
+
+    def _probe(self, key: tuple, uniq: np.ndarray, promote: bool = True):
+        """blocks[i] = stored block for uniq[i] (None = miss)."""
+        blocks: list = [None] * len(uniq)
+        stripe_ids = self._stripe_of(uniq)
+        for s in np.unique(stripe_ids):
+            st = self._stripes[int(s)]
+            sel = np.nonzero(stripe_ids == s)[0]
+            with st.lock:
+                for i in sel:
+                    k = (key, int(uniq[i]))
+                    b = st.map.get(k)
+                    if b is not None:
+                        if promote:
+                            st.map.move_to_end(k)
+                        blocks[int(i)] = b
+        return blocks
+
+    def _insert(self, key: tuple, ids: np.ndarray, blocks: list) -> None:
+        stripe_ids = self._stripe_of(ids)
+        for s in np.unique(stripe_ids):
+            st = self._stripes[int(s)]
+            sel = np.nonzero(stripe_ids == s)[0]
+            with st.lock:
+                for i in sel:
+                    b = blocks[int(i)]
+                    size = sum(len(c) for c in b) + _ENTRY_OVERHEAD
+                    if size > self._per_stripe:
+                        continue  # would evict the whole stripe for one row
+                    k = (key, int(ids[i]))
+                    old = st.map.pop(k, None)
+                    if old is not None:
+                        st.bytes -= sum(len(c) for c in old) + _ENTRY_OVERHEAD
+                    st.map[k] = b
+                    st.bytes += size
+                    while st.bytes > self._per_stripe and st.map:
+                        _, ev = st.map.popitem(last=False)
+                        st.bytes -= sum(len(c) for c in ev) + _ENTRY_OVERHEAD
+                        self.evictions += 1
+
+    def covers(self, key: tuple, ids) -> bool:
+        """True when EVERY id already has a block (no promotion, no
+        telemetry) — lets planners skip fetch steps for fully-cached
+        frontiers. Races with eviction are benign: the later fetch just
+        pays a residual RPC."""
+        ids = np.asarray(ids)
+        if ids.size == 0:
+            return True
+        uniq = np.unique(ids.reshape(-1))
+        return all(
+            b is not None for b in self._probe(key, uniq, promote=False)
+        )
+
+    def fetch(self, key: tuple, ids, fetch_fn):
+        """Deduplicated, cache-merged read of fixed-layout array results.
+
+        ``fetch_fn(miss_ids) -> [arr, ...]`` with every component's
+        leading dim == len(miss_ids) and a per-id layout that is constant
+        for this key (the verb wrappers guarantee that by folding every
+        shape-affecting argument — names, caps, max_len — into the key).
+        Returns the components assembled for the FULL ``ids`` in order —
+        bit-identical to ``fetch_fn(ids)``.
+        """
+        ids = np.asarray(ids)
+        if ids.size == 0:
+            return [np.asarray(a) for a in fetch_fn(ids)]
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        blocks = self._probe(key, uniq)
+        miss = [i for i, b in enumerate(blocks) if b is None]
+        n_hit = len(uniq) - len(miss)
+        if miss:
+            fetched = [
+                np.ascontiguousarray(a) for a in fetch_fn(uniq[np.asarray(miss)])
+            ]
+            meta = self._register_meta(key, fetched)
+            for j, i in enumerate(miss):
+                blocks[i] = tuple(a[j].tobytes() for a in fetched)
+            self._insert(key, uniq[np.asarray(miss)], [blocks[i] for i in miss])
+        meta = self._meta[key]
+        per_id = sum(m[2] for m in meta)
+        out = []
+        for k, (dt, shape, _nb) in enumerate(meta):
+            buf = b"".join(b[k] for b in blocks)
+            arr = np.frombuffer(buf, dtype=dt).reshape((len(uniq),) + shape)
+            out.append(arr[inv])  # fancy index: fresh writable copy
+        self.hits += n_hit
+        self.misses += len(miss)
+        self.bytes_saved += n_hit * per_id
+        ndup = int(ids.size - len(uniq))
+        self.dedup_ids += ndup
+        self.dedup_bytes_saved += ndup * per_id
+        return out
+
+    def fetch_objects(self, key: tuple, ids, fetch_fn):
+        """Like ``fetch`` for variable-length per-id payloads (binary
+        features): ``fetch_fn(miss_ids) -> [[bytes per id], ...]`` (one
+        list per component). Python-loop assembly — fine off the hot
+        path."""
+        ids = np.asarray(ids)
+        if ids.size == 0:
+            return [list(c) for c in fetch_fn(ids)]
+        uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        blocks = self._probe(key, uniq)
+        miss = [i for i, b in enumerate(blocks) if b is None]
+        n_hit = len(uniq) - len(miss)
+        if miss:
+            fetched = fetch_fn(uniq[np.asarray(miss)])
+            for j, i in enumerate(miss):
+                blocks[i] = tuple(c[j] for c in fetched)
+            self._insert(key, uniq[np.asarray(miss)], [blocks[i] for i in miss])
+        ncomp = len(blocks[0])
+        out = [[blocks[i][k] for i in inv] for k in range(ncomp)]
+        miss_set = set(miss)
+        self.hits += n_hit
+        self.misses += len(miss)
+        self.bytes_saved += sum(
+            sum(len(c) for c in b)
+            for i, b in enumerate(blocks)
+            if i not in miss_set
+        )
+        self.dedup_ids += int(ids.size - len(uniq))
+        return out
+
+    def insert_rows(self, key: tuple, ids, *components) -> None:
+        """Client-side write-back: store already-received rows (e.g. a
+        fused exec_plan response) under `key`. The caller's contract is
+        that each row equals what the keyed verb would return for that
+        id — which holds for any deterministic read the server answered."""
+        ids = np.asarray(ids).reshape(-1)
+        if ids.size == 0:
+            return
+        uniq, first = np.unique(ids, return_index=True)
+        comps = [np.ascontiguousarray(a) for a in components]
+        self._register_meta(key, comps)
+        blocks = [tuple(a[i].tobytes() for a in comps) for i in first]
+        self._insert(key, uniq, blocks)
+
+    def _register_meta(self, key: tuple, fetched: list) -> list:
+        with self._meta_lock:
+            meta = self._meta.get(key)
+            if meta is None:
+                meta = [
+                    (a.dtype, a.shape[1:], a[:1].nbytes if len(a) else 0)
+                    for a in fetched
+                ]
+                self._meta[key] = meta
+            return meta
+
+
+# process-wide telemetry for the dataflow-layer id coalescing
+# (dataflow/base.py gather_unique): duplicates removed BEFORE any fetch,
+# and the result bytes they would have re-shipped. GIL-racy increments —
+# telemetry, not an invariant (the repo's standing counter stance).
+GATHER_DEDUP = {"ids": 0, "bytes_saved": 0}
+
+
+def note_gather_dedup(n_dup: int, row_bytes: int) -> None:
+    GATHER_DEDUP["ids"] += int(n_dup)
+    GATHER_DEDUP["bytes_saved"] += int(n_dup) * int(row_bytes)
+
+
+def shard_caches(graph) -> list[ReadCache]:
+    """Every shard-level ReadCache hanging off a Graph facade."""
+    out = []
+    for sh in getattr(graph, "shards", []) or []:
+        c = getattr(sh, "_cache", None)
+        if isinstance(c, ReadCache):
+            out.append(c)
+    return out
+
+
+def graph_cache_stats(graph) -> dict | None:
+    """Summed cache telemetry across a facade's remote shards (None when
+    no shard carries a cache — local graphs, kill switch)."""
+    caches = shard_caches(graph)
+    if not caches:
+        return None
+    keys = (
+        "hits", "misses", "evictions", "invalidations", "bytes",
+        "budget_bytes", "bytes_saved", "dedup_ids", "dedup_bytes_saved",
+    )
+    agg = {k: sum(c.stats()[k] for c in caches) for k in keys}
+    lookups = agg["hits"] + agg["misses"]
+    agg["hit_rate"] = round(agg["hits"] / lookups, 4) if lookups else 0.0
+    return agg
+
+
+def clear_graph_caches(graph) -> None:
+    for c in shard_caches(graph):
+        c.clear()
+
+
+def seed_dense_rows(graph, ids, names, values) -> None:
+    """Write dense feature rows that arrived via a FUSED plan response
+    into the owning shards' read caches (keyed exactly like the
+    `get_dense_feature` verb). Fused responses bypass the per-verb cache
+    on the way in; seeding them keeps warm-plan runs able to skip their
+    root feature step, and later direct fetches of the same hot ids free."""
+    shards = getattr(graph, "shards", None)
+    if not shards:
+        return
+    ids = np.asarray(ids, dtype=np.uint64).reshape(-1)
+    values = np.asarray(values)
+    if ids.size == 0 or values.shape[0] != ids.size:
+        return
+    num = len(shards)
+    owner = (ids % np.uint64(num)).astype(np.int64)
+    key = ("dense", tuple(names))
+    for s, sh in enumerate(shards):
+        c = getattr(sh, "_cache", None)
+        if not isinstance(c, ReadCache):
+            continue
+        sel = np.nonzero(owner == s)[0]
+        if len(sel):
+            c.insert_rows(key, ids[sel], values[sel])
+
+
+def dense_coverage(graph, ids, names) -> bool:
+    """True when every shard's read cache already holds the dense rows
+    for its subset of ``ids`` — the precondition for a plan to skip its
+    root feature step entirely."""
+    shards = getattr(graph, "shards", None)
+    if not shards:
+        return False
+    ids = np.asarray(ids, dtype=np.uint64)
+    num = len(shards)
+    owner = (ids % np.uint64(num)).astype(np.int64)
+    for s, sh in enumerate(shards):
+        cov = getattr(sh, "cached_dense_coverage", None)
+        if cov is None:
+            return False
+        sub = ids[owner == s]
+        if len(sub) and not cov(sub, names):
+            return False
+    return True

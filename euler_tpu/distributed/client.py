@@ -25,6 +25,7 @@ import time
 import numpy as np
 
 from euler_tpu.distributed import chaos, wire
+from euler_tpu.distributed.cache import ReadCache, epoch_refresh_s
 from euler_tpu.distributed.errors import (  # noqa: F401 (re-exports)
     DeadlineExceeded,
     OverloadError,
@@ -165,14 +166,17 @@ class _Replica:
         wire_op = (
             op if budget_ms is None else wire.wrap_deadline(op, budget_ms)
         )
-        wire.send_frame(sock, wire.encode(wire_op, values))
+        # vectored send + borrow decode: request arrays ride as iovecs,
+        # response arrays slice the (per-frame, never-mutated) recv
+        # buffer — zero staging copies on either direction of the wire
+        wire.send_frame(sock, wire.encode_vectored(wire_op, values))
         payload = wire.read_frame(sock)
         if payload is None:
             # clean EOF — the server closed this connection (shutdown or
             # restart): a transport failure, so the caller fails over,
             # unlike an "err" status which is deterministic
             raise ConnectionError("connection closed by peer")
-        status, result = wire.decode(payload)
+        status, result = wire.decode(payload, borrow=True)
         if status == "err":
             raise from_wire(result[0])
         return result
@@ -273,6 +277,15 @@ class RemoteShard:
         # rpc_count, the proof that recovery was failover, not silent
         # skipping (GIL-racy increments fine: telemetry)
         self.retry_count = 0
+        # deterministic read cache (EULER_TPU_READ_CACHE=0 disables):
+        # hot-node rows are served from here instead of the wire, misses
+        # fetch only the residual ids (distributed/cache.py)
+        self._cache = ReadCache.from_env()
+        # graph_epoch handshake state: checked against the server's
+        # `stats` verb before the first cached read (and re-polled every
+        # EULER_TPU_READ_CACHE_EPOCH_S seconds when set)
+        self._epoch_checked = False
+        self._epoch_next = 0.0
 
     def _executor(self) -> _DaemonExecutor:
         """Bounded executor for overlapped requests — the async
@@ -428,14 +441,87 @@ class RemoteShard:
     def stats(self) -> dict:
         """The server's per-op request counters (the wire twin of reading
         GraphService.op_counts in-process — what the bench's RPC-count
-        lane and capacity dashboards poll)."""
-        return json.loads(self.call("stats", [])[0])
+        lane and capacity dashboards poll), with this handle's read-cache
+        telemetry attached under "read_cache"."""
+        out = json.loads(self.call("stats", [])[0])
+        if self._cache is not None:
+            # a stats poll doubles as an epoch observation: a bumped
+            # graph_epoch invalidates the cache right here
+            self._cache.observe_epoch(out.get("graph_epoch", 0))
+            out["read_cache"] = self._cache.stats()
+        return out
+
+    # -- read cache plumbing --------------------------------------------
+
+    def refresh_epoch(self) -> int:
+        """Re-read the server's graph_epoch; a mismatch flushes the read
+        cache (mutable graphs must never serve stale bytes). Returns the
+        observed epoch (0 for servers predating the field — immutable
+        stores, cache-forever)."""
+        epoch = self._fetch_epoch()
+        if self._cache is not None:
+            self._cache.observe_epoch(epoch)
+        return epoch
+
+    def _fetch_epoch(self) -> int:
+        try:
+            return int(
+                json.loads(self.call("stats", [])[0]).get("graph_epoch", 0)
+            )
+        except RpcError as e:
+            if "unknown op" in str(e):
+                return 0  # pre-`stats` server: immutable era, cache-forever
+            raise
+
+    def _cached(self) -> "ReadCache | None":
+        """The read cache, after epoch maintenance: the first cached read
+        (and every EULER_TPU_READ_CACHE_EPOCH_S seconds when set) costs
+        one `stats` RPC to learn the server's graph_epoch."""
+        c = self._cache
+        if c is None:
+            return None
+        now = time.monotonic()
+        if self._epoch_checked and (
+            self._epoch_next == 0.0 or now < self._epoch_next
+        ):
+            return c
+        # RPC outside the lock (call() takes self._lock in _pick — a
+        # locked fetch would self-deadlock); publish under it. Racing
+        # first readers fetch twice, observe the same epoch: benign.
+        epoch = self._fetch_epoch()
+        ttl = epoch_refresh_s()
+        with self._lock:
+            c.observe_epoch(epoch)
+            self._epoch_checked = True
+            self._epoch_next = now + ttl if ttl > 0 else 0.0
+        return c
+
+    def cached_dense_coverage(self, ids, names) -> bool:
+        """True when every id's dense-feature row for `names` is already
+        cached — planners then skip the server-side feature step."""
+        c = self._cache
+        return c is not None and c.covers(
+            ("dense", tuple(names)), np.asarray(ids, np.uint64)
+        )
 
     def lookup(self, ids):
-        return self.call("lookup", [np.asarray(ids, np.uint64)])[0]
+        ids = np.asarray(ids, np.uint64)
+        c = self._cached()
+        if c is None:
+            return self.call("lookup", [ids])[0]
+        return c.fetch(
+            ("lookup",), ids, lambda miss: [self.call("lookup", [miss])[0]]
+        )[0]
 
     def node_type(self, ids):
-        return self.call("node_type", [np.asarray(ids, np.uint64)])[0]
+        ids = np.asarray(ids, np.uint64)
+        c = self._cached()
+        if c is None:
+            return self.call("node_type", [ids])[0]
+        return c.fetch(
+            ("node_type",), ids,
+            lambda miss: [self.call("node_type", [miss])[0]],
+        )[0]
 
     def sample_node(self, count, node_type=-1, rng=None):
         return self.call("sample_node", [count, node_type, _seed(rng)])[0]
@@ -482,15 +568,31 @@ class RemoteShard:
     def get_full_neighbor(
         self, ids, edge_types=None, max_degree=None, in_edges=False, sort_by=None
     ):
-        out = self.call(
-            "get_full_neighbor",
-            [
-                np.asarray(ids, np.uint64),
-                _types(edge_types),
-                max_degree,
-                in_edges,
-                sort_by,
-            ],
+        ids = np.asarray(ids, np.uint64)
+        c = self._cached() if max_degree is not None else None
+        if c is None:
+            # cap-less responses are padded to the BATCH max degree —
+            # per-id rows then depend on their neighbors in the request,
+            # so only fixed-cap calls are cacheable
+            out = self.call(
+                "get_full_neighbor",
+                [ids, _types(edge_types), max_degree, in_edges, sort_by],
+            )
+            return _bool_mask(out, 3)
+        key = (
+            "full_nb",
+            None if edge_types is None else tuple(_types(edge_types)),
+            int(max_degree),
+            bool(in_edges),
+            sort_by,
+        )
+        out = c.fetch(
+            key,
+            ids,
+            lambda miss: self.call(
+                "get_full_neighbor",
+                [miss, _types(edge_types), int(max_degree), in_edges, sort_by],
+            ),
         )
         return _bool_mask(out, 3)
 
@@ -502,9 +604,23 @@ class RemoteShard:
         return _bool_mask(out, 3)
 
     def degree_sum(self, ids, edge_types=None, in_edges=False):
-        return self.call(
-            "degree_sum",
-            [np.asarray(ids, np.uint64), _types(edge_types), in_edges],
+        ids = np.asarray(ids, np.uint64)
+        c = self._cached()
+        if c is None:
+            return self.call(
+                "degree_sum", [ids, _types(edge_types), in_edges]
+            )[0]
+        key = (
+            "deg",
+            None if edge_types is None else tuple(_types(edge_types)),
+            bool(in_edges),
+        )
+        return c.fetch(
+            key,
+            ids,
+            lambda miss: [
+                self.call("degree_sum", [miss, _types(edge_types), in_edges])[0]
+            ],
         )[0]
 
     def sample_neighbor_layerwise(self, batch_ids, edge_types=None, count=128, rng=None):
@@ -686,13 +802,29 @@ class RemoteShard:
         }
 
     def get_dense_feature(self, ids, names):
-        return self.call(
-            "get_dense_feature", [np.asarray(ids, np.uint64), list(names)]
+        ids = np.asarray(ids, np.uint64)
+        c = self._cached()
+        if c is None:
+            return self.call("get_dense_feature", [ids, list(names)])[0]
+        return c.fetch(
+            ("dense", tuple(names)),
+            ids,
+            lambda miss: [
+                self.call("get_dense_feature", [miss, list(names)])[0]
+            ],
         )[0]
 
     def get_dense_by_rows(self, rows, names):
-        return self.call(
-            "get_dense_by_rows", [np.asarray(rows, np.int64), list(names)]
+        rows = np.asarray(rows, np.int64)
+        c = self._cached()
+        if c is None:
+            return self.call("get_dense_by_rows", [rows, list(names)])[0]
+        return c.fetch(
+            ("dense_rows", tuple(names)),
+            rows,
+            lambda miss: [
+                self.call("get_dense_by_rows", [miss, list(names)])[0]
+            ],
         )[0]
 
     def get_dense_feature_udf(self, ids, names, udfs):
@@ -706,26 +838,48 @@ class RemoteShard:
         return out[0], out[1]
 
     def get_sparse_feature(self, ids, names, max_len=None):
-        flat = self.call(
-            "get_sparse_feature",
-            [np.asarray(ids, np.uint64), list(names), max_len],
-        )
+        ids = np.asarray(ids, np.uint64)
+        c = self._cached() if max_len is not None else None
+        if c is None:
+            # cap-less responses pad to the batch max length — per-id
+            # rows then depend on the rest of the request (same rule as
+            # get_full_neighbor): not cacheable
+            flat = self.call(
+                "get_sparse_feature", [ids, list(names), max_len]
+            )
+        else:
+            flat = c.fetch(
+                ("sparse", tuple(names), int(max_len)),
+                ids,
+                lambda miss: self.call(
+                    "get_sparse_feature", [miss, list(names), int(max_len)]
+                ),
+            )
         return [
             (flat[2 * i], flat[2 * i + 1].astype(bool))
             for i in range(len(names))
         ]
 
-    def get_binary_feature(self, ids, names):
-        flat = self.call(
-            "get_binary_feature", [np.asarray(ids, np.uint64), list(names)]
-        )
+    @staticmethod
+    def _binary_from_wire(flat: list, n_names: int) -> list[list[bytes]]:
+        """Wire (offsets, u8 blob) pairs → per-name lists of bytes."""
         out = []
-        for i in range(len(names)):
+        for i in range(n_names):
             offs, blob = flat[2 * i], flat[2 * i + 1].tobytes()
             out.append(
-                [blob[offs[j] : offs[j + 1]] for j in range(len(offs) - 1)]
+                [bytes(blob[offs[j] : offs[j + 1]]) for j in range(len(offs) - 1)]
             )
         return out
+
+    def get_binary_feature(self, ids, names):
+        ids = np.asarray(ids, np.uint64)
+        c = self._cached()
+        fetch = lambda sub: self._binary_from_wire(
+            self.call("get_binary_feature", [sub, list(names)]), len(names)
+        )
+        if c is None:
+            return fetch(ids)
+        return c.fetch_objects(("bin", tuple(names)), ids, fetch)
 
     def get_edge_dense_feature(self, edge_ids, names):
         return self.call(

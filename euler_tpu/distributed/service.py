@@ -321,27 +321,32 @@ class _PoolServer:
         _REQUEST.deadline = deadline
         try:
             result = self.service.dispatch(op, args)
-            frame = wire.encode("ok", result)
+            # vectored response: big result arrays leave as iovecs
+            # straight from the store's buffers, never staged into a
+            # flat frame copy
+            frame = wire.encode_vectored("ok", result)
         except Exception as e:  # report (typed by class name), keep serving
             frame = wire.encode("err", [f"{type(e).__name__}: {e}"])
         finally:
             _REQUEST.deadline = None
-        if truncate:
-            # torn frame: correct length prefix, then the stream dies
-            try:
-                sock.sendall(frame[: max(5, len(frame) // 2)])
-            except (ConnectionError, OSError):
-                pass
-            return "close"
-        if corrupt:
-            # well-framed garbage: length prefix intact, payload flipped
-            buf = bytearray(frame)
-            for i in range(4, len(buf), max(1, len(buf) // 8)):
-                buf[i] ^= 0xFF
-            frame = bytes(buf)
+        if truncate or corrupt:
+            # chaos paths need a flat mutable frame to tear/flip
+            flat = bytearray().join(
+                frame if isinstance(frame, list) else [frame]
+            )
+            if truncate:
+                # torn frame: correct length prefix, then the stream dies
+                try:
+                    sock.sendall(flat[: max(5, len(flat) // 2)])
+                except (ConnectionError, OSError):
+                    pass
+                return "close"
+            for i in range(4, len(flat), max(1, len(flat) // 8)):
+                flat[i] ^= 0xFF
+            frame = flat
         return self._send(sock, frame)
 
-    def _send(self, sock: socket.socket, frame: bytes) -> str:
+    def _send(self, sock: socket.socket, frame) -> str:
         try:
             wire.send_frame(sock, frame)
         except (ConnectionError, OSError):
@@ -508,9 +513,15 @@ class GraphService:
         if op == "ping":
             return [self.shard]
         if op == "stats":
-            return [json.dumps(
-                {"shard": self.shard, "op_counts": dict(self.op_counts)}
-            )]
+            # graph_epoch versions the shard's data for client read
+            # caches: bump it on any mutation and every client flushes on
+            # its next observation. Old clients ignore the field; old
+            # SERVERS omit it, which clients read as 0 = cache-forever.
+            return [json.dumps({
+                "shard": self.shard,
+                "op_counts": dict(self.op_counts),
+                "graph_epoch": int(getattr(s, "graph_epoch", 0)),
+            })]
         if op == "num_nodes":
             return [int(s.num_nodes)]
         if op == "exec_plan":

@@ -25,6 +25,23 @@ server answers the envelope with "unknown op '@dl:...'", which clients
 treat as a degrade signal: drop the envelope for that shard and resend
 (deadlines then only bound the client side). Frame layout is untouched,
 so every other verb stays byte-compatible in both directions.
+
+Zero-copy I/O discipline (the hot-path contract):
+
+- send: `encode_vectored` keeps large array payloads as memoryviews of
+  the source arrays and `send_frame` scatter-gathers them with
+  `sendmsg`, so a multi-MB feature block is never copied into a staging
+  buffer; small values coalesce into one header buffer whose first four
+  bytes are the length prefix (packed in place — no header + payload
+  concatenation copy).
+- recv: `_read_exact` recv_into's ONE exact-size bytearray (no chunk
+  list, no b"".join copy, no 1 MiB recv cap forcing extra syscalls on
+  multi-MB frames).
+- decode: `borrow=True` makes decoded arrays SLICE the frame buffer
+  instead of copying it. Safe because every frame gets a fresh buffer
+  that nothing mutates; consumers that retain per-id blocks (the client
+  read cache) copy just the rows they keep, so a few cached rows never
+  pin a whole frame.
 """
 
 from __future__ import annotations
@@ -54,7 +71,21 @@ def unwrap_deadline(op: str) -> tuple[str, float | None]:
     return inner, float(ms)
 
 
-def _pack_value(buf: bytearray, v) -> None:
+# arrays at least this big ride as their own iovec in the vectored
+# encode (below it, appending to the header buffer beats iovec overhead)
+_VECTOR_MIN_BYTES = 4096
+
+
+def _tail(parts: list) -> bytearray:
+    """The bytearray small values accumulate into — a fresh one after
+    every zero-copy iovec so wire order is preserved."""
+    if not isinstance(parts[-1], bytearray):
+        parts.append(bytearray())
+    return parts[-1]
+
+
+def _pack_value(parts: list, v, vectored: bool) -> None:
+    buf = _tail(parts)
     if isinstance(v, np.ndarray):
         v = np.ascontiguousarray(v)
         if v.dtype == np.bool_:
@@ -62,7 +93,12 @@ def _pack_value(buf: bytearray, v) -> None:
         buf += struct.pack("<BBB", 0, _DTYPE_CODES[v.dtype], v.ndim)
         for d in v.shape:
             buf += struct.pack("<q", d)
-        buf += v.tobytes()
+        if vectored and v.nbytes >= _VECTOR_MIN_BYTES:
+            # zero-copy: the array's own buffer becomes an iovec; the
+            # memoryview keeps the (contiguous) source alive until sent
+            parts.append(memoryview(v.reshape(-1).view(np.uint8)))
+        else:
+            buf += v.tobytes()
     elif isinstance(v, bool):
         buf += struct.pack("<BB", 5, int(v))
     elif isinstance(v, (int, np.integer)):
@@ -78,12 +114,12 @@ def _pack_value(buf: bytearray, v) -> None:
     elif isinstance(v, (list, tuple)):
         buf += struct.pack("<BH", 6, len(v))
         for item in v:
-            _pack_value(buf, item)
+            _pack_value(parts, item, vectored)
     else:
         raise TypeError(f"cannot encode {type(v)}")
 
 
-def _unpack_value(view: memoryview, off: int):
+def _unpack_value(view: memoryview, off: int, borrow: bool = False):
     (tag,) = struct.unpack_from("<B", view, off)
     off += 1
     if tag == 0:
@@ -97,11 +133,11 @@ def _unpack_value(view: memoryview, off: int):
         dt = _CODE_DTYPES[code]
         n = int(np.prod(shape)) if shape else 1
         nbytes = dt.itemsize * n
-        arr = (
-            np.frombuffer(view[off : off + nbytes], dtype=dt)
-            .reshape(shape)
-            .copy()
+        arr = np.frombuffer(view[off : off + nbytes], dtype=dt).reshape(
+            shape
         )
+        if not borrow:
+            arr = arr.copy()
         return arr, off + nbytes
     if tag == 1:
         (v,) = struct.unpack_from("<q", view, off)
@@ -123,37 +159,58 @@ def _unpack_value(view: memoryview, off: int):
         off += 2
         items = []
         for _ in range(n):
-            item, off = _unpack_value(view, off)
+            item, off = _unpack_value(view, off, borrow)
             items.append(item)
         return items, off
     raise ValueError(f"bad tag {tag}")
 
 
-def encode(op: str, values) -> bytes:
-    buf = bytearray()
+def _encode_parts(op: str, values, vectored: bool) -> list:
+    head = bytearray(4)  # length-prefix placeholder, packed in place
+    parts: list = [head]
     raw = op.encode()
-    buf += struct.pack("<H", len(raw))
-    buf += raw
-    buf += struct.pack("<H", len(values))
+    head += struct.pack("<H", len(raw))
+    head += raw
+    head += struct.pack("<H", len(values))
     for v in values:
-        _pack_value(buf, v)
-    return struct.pack("<I", len(buf)) + bytes(buf)
+        _pack_value(parts, v, vectored)
+    total = sum(len(p) for p in parts) - 4
+    if total > MAX_FRAME:
+        raise ValueError(f"frame too large: {total}")
+    struct.pack_into("<I", head, 0, total)
+    return parts
 
 
-def decode(payload: bytes) -> tuple[str, list]:
+def encode(op: str, values) -> bytearray:
+    """One flat frame (length prefix included). Built in place — no
+    header + payload concatenation copy."""
+    parts = _encode_parts(op, values, vectored=False)
+    return parts[0]  # vectored=False keeps everything in the head buffer
+
+
+def encode_vectored(op: str, values) -> list:
+    """Frame as an ordered buffer list for sendmsg scatter-gather: large
+    arrays stay views of their source buffers (zero copies), small values
+    coalesce around them. `b"".join(parts)` equals `encode(op, values)`."""
+    return _encode_parts(op, values, vectored=True)
+
+
+def decode(payload, borrow: bool = False) -> tuple[str, list]:
     # any malformed payload (truncated, corrupted, garbage) surfaces as
     # ValueError — ONE exception type for "this frame is broken", which
     # clients treat as a transport fault (failover) and servers as a
-    # connection-costing error, never a hang or a dead worker
+    # connection-costing error, never a hang or a dead worker.
+    # borrow=True: decoded arrays are views of `payload` (no copy) —
+    # callers must hand each frame its own buffer and never mutate it.
     try:
-        return _decode(payload)
+        return _decode(payload, borrow)
     except ValueError:
         raise
     except (struct.error, IndexError, UnicodeDecodeError, KeyError) as e:
         raise ValueError(f"malformed frame: {type(e).__name__}: {e}") from e
 
 
-def _decode(payload: bytes) -> tuple[str, list]:
+def _decode(payload, borrow: bool) -> tuple[str, list]:
     view = memoryview(payload)
     (op_len,) = struct.unpack_from("<H", view, 0)
     off = 2
@@ -163,12 +220,12 @@ def _decode(payload: bytes) -> tuple[str, list]:
     off += 2
     values = []
     for _ in range(n):
-        v, off = _unpack_value(view, off)
+        v, off = _unpack_value(view, off, borrow)
         values.append(v)
     return op, values
 
 
-def read_frame(sock: socket.socket) -> bytes | None:
+def read_frame(sock: socket.socket) -> bytearray | None:
     header = _read_exact(sock, 4)
     if header is None:
         return None
@@ -178,17 +235,40 @@ def read_frame(sock: socket.socket) -> bytes | None:
     return _read_exact(sock, n)
 
 
-def _read_exact(sock: socket.socket, n: int) -> bytes | None:
-    chunks = []
+def _read_exact(sock: socket.socket, n: int) -> bytearray | None:
+    """Read exactly n bytes into ONE exact-size buffer via recv_into —
+    no per-chunk allocations, no b"".join copy, and no artificial recv
+    cap adding syscalls on multi-MB frames. The buffer is fresh per
+    frame, which is what makes decode's borrow mode safe. None on EOF
+    (clean between frames, torn mid-frame — callers treat both as a
+    transport fault)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
     got = 0
     while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
-        if not chunk:
+        r = sock.recv_into(view[got:])
+        if r == 0:
             return None
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
+        got += r
+    return buf
 
 
-def send_frame(sock: socket.socket, data: bytes) -> None:
-    sock.sendall(data)
+def send_frame(sock: socket.socket, data) -> None:
+    """Send one frame: flat bytes-like, or an `encode_vectored` buffer
+    list scatter-gathered through sendmsg (sequential sendall where
+    sendmsg is unavailable). Partial sendmsg results are resumed."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        sock.sendall(data)
+        return
+    bufs = [memoryview(p).cast("B") for p in data if len(p)]
+    if not hasattr(sock, "sendmsg"):
+        for b in bufs:
+            sock.sendall(b)
+        return
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if sent:
+            bufs[0] = bufs[0][sent:]

@@ -44,7 +44,8 @@ class DeviceFeatureCache:
         instead of one transfer — big tables (hundreds of MB) shipped as a
         single device_put can trip transport limits on proxied/tunneled
         devices; chunking bounds each transfer."""
-        host = graph.dense_feature_table(list(feature_names))
+        self.feature_names = list(feature_names)
+        host = graph.dense_feature_table(self.feature_names)
         self.dim = host.shape[1]
         table = np.concatenate(
             [np.zeros((1, self.dim), np.float32), host], axis=0
@@ -73,6 +74,26 @@ class DeviceFeatureCache:
     def gather(self, rows) -> jnp.ndarray:
         """int32 rows (0 = padding) → dense [n, F]; jit-safe."""
         return self.table[rows]
+
+    def refresh_rows(self, graph, rows) -> int:
+        """Residual re-staging: refetch ONLY the given global rows and
+        patch them into the device table (row+1 space, row 0 stays the
+        zero/padding row). The cheap path after a `graph_epoch` bump —
+        mutated hot rows re-stage in one small transfer instead of
+        re-shipping the whole table. Against a remote graph the fetch
+        rides `get_dense_by_rows`, so the client read cache's residual
+        logic applies to it too. Returns how many rows were re-staged."""
+        rows = np.unique(np.asarray(rows, dtype=np.int64).reshape(-1))
+        rows = rows[(rows >= 0) & (rows + 1 < self.table.shape[0])]
+        if not len(rows):
+            return 0
+        vals = np.asarray(
+            graph.get_dense_by_rows(rows, self.feature_names), np.float32
+        )
+        self.table = self.table.at[rows + 1].set(
+            jnp.asarray(vals, dtype=self.table.dtype)
+        )
+        return int(len(rows))
 
     def hydrate(self, batch):
         """MiniBatch with rows-mode feature slots → dense feature slots.

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable
 
 
@@ -68,10 +69,31 @@ class Prefetcher:
                 if self._stop.is_set() and self._error is None:
                     raise RuntimeError("prefetcher stopped")
 
-    def close(self):
+    def close(self, timeout_s: float = 5.0):
+        """Stop producers and JOIN their threads (bounded).
+
+        The one-shot drain the old close() did raced its own workers: a
+        worker blocked in `q.put` could publish one more (stale) batch
+        into the just-drained queue after close() returned — a later
+        consumer of the same queue object would read a batch from a
+        supposedly-dead prefetcher. Draining *until the workers are
+        actually joined* closes that window; workers stuck in a slow
+        batch_fn (e.g. an RPC riding a dead peer's timeout) are given
+        `timeout_s` and then abandoned — they are daemon threads and the
+        final drain still empties whatever they managed to publish."""
         self._stop.set()
-        while not self.q.empty():
+        deadline = time.monotonic() + timeout_s
+        alive = [t for t in self._threads if t.is_alive()]
+        while alive and time.monotonic() < deadline:
+            self._drain()  # unblock workers waiting in q.put
+            for t in alive:
+                t.join(timeout=0.05)
+            alive = [t for t in alive if t.is_alive()]
+        self._drain()
+
+    def _drain(self):
+        while True:
             try:
                 self.q.get_nowait()
             except queue.Empty:
-                break
+                return

@@ -346,6 +346,16 @@ class GraphStore:
         self._index_mgr = None
         self._edge_index_mgr = None
         self._unit_w: dict[int, bool] = {}  # per-type all-weights-==-1.0
+        # data version served over the wire (`stats.graph_epoch`): any
+        # in-place mutation of this shard's arrays must bump_epoch() so
+        # client read caches invalidate instead of serving stale bytes
+        self.graph_epoch = 0
+
+    def bump_epoch(self) -> int:
+        """Advance the shard's data version after an in-place mutation;
+        remote read caches flush on the next epoch observation."""
+        self.graph_epoch += 1
+        return self.graph_epoch
 
     # ---- id resolution -------------------------------------------------
 

@@ -215,15 +215,22 @@ def full_neighbor_plan(
     label: str | None = None,
     rows: bool = False,
     degrees: bool = False,
+    root_features: bool = True,
 ) -> list:
     """FullNeighborDataFlow's whole query as one plan: per hop a capped
     full-neighbor expansion (+ features / true degrees), fetched next to
-    the data instead of one RPC round per hop per kind."""
+    the data instead of one RPC round per hop per kind.
+
+    root_features=False drops the hop-0 feature tap (__f0): when the
+    client's read cache already holds every root's rows, shipping them
+    again is pure waste — the caller fills hop 0 from the cache. Results
+    are bit-identical either way (the cache stores what the server
+    serves), so both fused and per-op lanes of the SAME plan agree."""
     et = None if edge_types is None else [int(t) for t in edge_types]
     plan = [{"op": "v", "conds": None}]
 
-    def tap(h):
-        if feature_names:
+    def tap(h, feats: bool = True):
+        if feature_names and feats:
             plan.append({"op": "values", "names": list(feature_names),
                          "udfs": [], "as": f"__f{h}"})
         if degrees:
@@ -232,7 +239,7 @@ def full_neighbor_plan(
     if label:
         plan.append({"op": "values", "names": [label], "udfs": [],
                      "as": "__labels"})
-    tap(0)
+    tap(0, feats=root_features)
     for h in range(num_hops):
         plan.append({"op": "full_nb", "et": et, "in_edges": False,
                      "cap": int(max_degree), "conds": None,

@@ -1,0 +1,43 @@
+"""Known-bad unbounded-cache fixture — every pattern here must trip.
+
+A request-keyed memo on a worker path: every distinct key a long-lived
+server sees stays resident forever (the slow-leak class the checker
+exists for)."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class ResultCacheUnbounded:
+    """Grows per request key on a thread-reachable path, never evicts."""
+
+    def __init__(self):
+        self._results = {}
+        self._seen = dict()
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+
+    def _serve(self):
+        while True:
+            self._handle("key")
+
+    def _handle(self, key):
+        if key not in self._results:
+            self._results[key] = self._compute(key)  # finding 1
+        self._seen.setdefault(key, 0)  # finding 2
+        return self._results[key]
+
+    def _compute(self, key):
+        return key
+
+
+_GLOBAL_MEMO = {}
+
+
+def _pool_job(request_id):
+    _GLOBAL_MEMO[request_id] = request_id * 2  # finding 3
+    return _GLOBAL_MEMO[request_id]
+
+
+def start(job):
+    pool = ThreadPoolExecutor(2)
+    return pool.submit(_pool_job, job)

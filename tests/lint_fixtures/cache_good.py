@@ -1,0 +1,73 @@
+"""Clean unbounded-cache fixture — the bounded / exempt forms of
+cache_bad.py. Must produce ZERO unbounded-cache findings.
+
+`BoundedLru` is the read-cache form this repo actually ships
+(euler_tpu/distributed/cache.py): OrderedDict under a lock, inserts
+evict LRU entries past a byte budget."""
+
+import collections
+import threading
+import weakref
+from concurrent.futures import ThreadPoolExecutor
+
+
+class BoundedLru:
+    """The distributed/cache.py ReadCache form: eviction under a budget."""
+
+    def __init__(self, budget):
+        self._lock = threading.Lock()
+        self._map = collections.OrderedDict()
+        self._budget = budget
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+
+    def _serve(self):
+        while True:
+            self._put("key", b"value")
+
+    def _put(self, key, value):
+        with self._lock:
+            self._map[key] = value
+            while len(self._map) > self._budget:
+                self._map.popitem(last=False)  # LRU eviction = the bound
+
+
+class ResetOnEpoch:
+    """Reset-by-rebind outside __init__ is a bound (invalidations)."""
+
+    def __init__(self):
+        self._rows = {}
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+
+    def _serve(self):
+        self._rows["k"] = 1
+
+    def clear(self):
+        self._rows = {}
+
+
+class TelemetryNotACache:
+    """Counters are telemetry, weak dicts self-evict — both exempt."""
+
+    def __init__(self):
+        self.op_counts = collections.Counter()
+        self._programs = weakref.WeakKeyDictionary()
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+
+    def _serve(self):
+        self.op_counts["op"] += 1
+        self._programs[object()] = 1
+
+
+_BOUNDED_GLOBAL = {}
+
+
+def _pool_job(request_id):
+    _BOUNDED_GLOBAL[request_id] = request_id
+    if len(_BOUNDED_GLOBAL) > 64:
+        _BOUNDED_GLOBAL.clear()
+    return _BOUNDED_GLOBAL[request_id]
+
+
+def start(job):
+    pool = ThreadPoolExecutor(2)
+    return pool.submit(_pool_job, job)

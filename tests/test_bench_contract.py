@@ -63,3 +63,34 @@ def test_bench_smoke_emits_final_json_line():
     assert rrow["per_batch_ms"] > 0
     assert "deadline_wire_overhead_pct" in rrow
     assert row["recovery_ttfb_ms"] == rrow["value"]
+
+
+def test_bench_smoke_remote_lane_cache_fields():
+    """The remote lane's artifact must carry the read-cache sub-metrics:
+    hit rate, dedup byte accounting, and the uncached/cold/warm A/B
+    (EULER_BENCH_CACHE=0 would drop them — default is on)."""
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke", "--remote-only"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    json_lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert json_lines, r.stdout[-500:]
+    row = json.loads(json_lines[-1])
+    assert row["metric"] == "graphsage_remote_edges_per_sec_per_chip"
+    assert row["value"] > 0, row
+    assert row["cache_hit_rate"] > 0
+    assert row["dedup_bytes_saved"] > 0
+    for k in (
+        "cache_uncached_edges_per_sec",
+        "cache_cold_edges_per_sec",
+        "cache_warm_edges_per_sec",
+        "cache_warm_over_uncached",
+    ):
+        assert row[k] > 0, (k, row)

@@ -191,6 +191,7 @@ def test_torn_and_corrupt_frames_failover_not_hang(ha_cluster):
     bounded by the deadline, never a hang."""
     remote, _, _ = ha_cluster
     sh = remote.shards[0]
+    sh._cache = None  # transport-fault proof: reads must hit the wire
     expected = sh.lookup(IDS)
     for kind in ("truncate", "corrupt"):
         before = sh.retry_count

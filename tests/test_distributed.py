@@ -324,10 +324,7 @@ def test_sage_minibatch_weighted_lean_wire(
         # wire-bytes bound: weighted-lean response within ~1.6x of the
         # unit-lean response for the same batch geometry
         def resp_bytes(payload):
-            buf = bytearray()
-            for v in payload:
-                wire._pack_value(buf, v)
-            return len(buf)
+            return len(wire.encode("ok", payload)) - 4
 
         lean_w_resp = services[0]._sage_minibatch(
             4, None, [3], "dense3", -1, 0, True
@@ -744,10 +741,7 @@ def test_remote_gql_udf_server_side(tmp_path, rng):
 
         # the op-level contract: aggregate response ≪ block response
         def resp_bytes(values):
-            buf = bytearray()
-            for v in values:
-                wire._pack_value(buf, v)
-            return len(buf)
+            return len(wire.encode("ok", values)) - 4
 
         shard = remote.shards[0]
         agg_resp = shard.call(

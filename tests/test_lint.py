@@ -132,6 +132,23 @@ def test_lock_discipline_fixed_form_clean():
     assert _check(_fixture_project("lock_good.py"), "lock-discipline") == []
 
 
+def test_unbounded_cache_fixture_trips():
+    findings = _check(_fixture_project("cache_bad.py"), "unbounded-cache")
+    ids = _ids(findings)
+    assert ids["unbounded-cache"] == 3, findings
+    # the class-attr memo and the module-global memo are both covered
+    symbols = {f.symbol for f in findings}
+    assert "ResultCacheUnbounded._handle" in symbols
+    assert "_pool_job" in symbols
+
+
+def test_unbounded_cache_fixed_form_clean():
+    # cache_good.py mirrors the shipped ReadCache (LRU eviction under a
+    # budget), the epoch reset-by-rebind, and the exempt Counter /
+    # WeakKeyDictionary forms
+    assert _check(_fixture_project("cache_good.py"), "unbounded-cache") == []
+
+
 def test_determinism_fixture_trips():
     findings = _check(_fixture_project("det_bad.py"), "determinism")
     ids = _ids(findings)

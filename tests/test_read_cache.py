@@ -1,0 +1,364 @@
+"""Client read cache (distributed/cache.py): bit-parity with the
+uncached wire in both planner lanes, residual-fetch dedup proven by
+server op counters, graph_epoch invalidation, negative entries,
+eviction bounds, thread safety, and old-server degrade.
+
+The standing contract this file pins: the cache may only change HOW MANY
+bytes cross the wire, never a single byte of any result."""
+
+import json
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from euler_tpu.dataflow import SageDataFlow
+from euler_tpu.dataflow.sage import FullNeighborDataFlow
+from euler_tpu.datasets.synthetic import random_graph
+from euler_tpu.distributed import connect, serve_shard
+from euler_tpu.distributed.cache import (
+    ReadCache,
+    clear_graph_caches,
+    dense_coverage,
+    graph_cache_stats,
+)
+from euler_tpu.graph import Graph
+from euler_tpu.graph import format as tformat
+
+MISSING = np.uint64(0xFFFFFFFFFFFFFFFF - 7)  # never a generated id
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    base = tmp_path_factory.mktemp("rcache")
+    data = str(base / "data")
+    reg = str(base / "reg")
+    os.makedirs(reg)
+    g = random_graph(
+        num_nodes=300, out_degree=6, feat_dim=8, seed=5, num_partitions=2
+    )
+    for p, sh in enumerate(g.shards):
+        tformat.write_arrays(os.path.join(data, f"part_{p}"), sh.arrays)
+    g.meta.save(data)
+    services = [
+        serve_shard(data, 0, registry_path=reg, native=False),
+        serve_shard(data, 1, registry_path=reg, native=False),
+    ]
+    remote = connect(registry_path=reg, num_shards=2)
+    local = Graph.load(data, native=False)
+    yield remote, local, services
+    for s in services:
+        s.stop()
+
+
+def _op_total(services, op):
+    return sum(s.op_counts.get(op, 0) for s in services)
+
+
+IDS = np.asarray([1, 2, 3, 4, 5, 6, 7, 8, 3, 2, 1, 9, 10], np.uint64)
+
+
+def test_cached_reads_bit_identical_and_residual_only(cluster):
+    remote, local, services = cluster
+    clear_graph_caches(remote)
+    # cold: every value identical to local truth
+    np.testing.assert_array_equal(
+        remote.get_dense_feature(IDS, ["feat"]),
+        local.get_dense_feature(IDS, ["feat"]),
+    )
+    np.testing.assert_array_equal(
+        remote.degree_sum(IDS), local.degree_sum(IDS)
+    )
+    np.testing.assert_array_equal(
+        remote.node_type(IDS), local.node_type(IDS)
+    )
+    np.testing.assert_array_equal(
+        remote.lookup_rows(IDS), local.lookup_rows(IDS)
+    )
+    for r, l in zip(
+        remote.get_full_neighbor(IDS, max_degree=6),
+        local.get_full_neighbor(IDS, max_degree=6),
+    ):
+        np.testing.assert_array_equal(r, l)
+    # warm: zero additional RPCs for fully-cached reads, identical bytes
+    before = {
+        op: _op_total(services, op)
+        for op in ("get_dense_feature", "degree_sum", "get_full_neighbor")
+    }
+    np.testing.assert_array_equal(
+        remote.get_dense_feature(IDS, ["feat"]),
+        local.get_dense_feature(IDS, ["feat"]),
+    )
+    np.testing.assert_array_equal(
+        remote.degree_sum(IDS), local.degree_sum(IDS)
+    )
+    for r, l in zip(
+        remote.get_full_neighbor(IDS, max_degree=6),
+        local.get_full_neighbor(IDS, max_degree=6),
+    ):
+        np.testing.assert_array_equal(r, l)
+    for op, n in before.items():
+        assert _op_total(services, op) == n, f"{op} re-fetched a cached id"
+    # residual fetch: extending the id set ships ONLY the new ids — the
+    # server-side call count rises, but cached rows stay client-side
+    ext = np.concatenate([IDS, np.asarray([11, 12], np.uint64)])
+    n_dense = _op_total(services, "get_dense_feature")
+    np.testing.assert_array_equal(
+        remote.get_dense_feature(ext, ["feat"]),
+        local.get_dense_feature(ext, ["feat"]),
+    )
+    assert _op_total(services, "get_dense_feature") > n_dense
+    st = graph_cache_stats(remote)
+    assert st["hits"] > 0 and st["bytes_saved"] > 0
+
+
+def test_request_dedup_accounting(cluster):
+    """Duplicate ids never reach the wire: a batch citing one id 50×
+    fetches it once, and the byte accounting records what the old wire
+    would have re-shipped."""
+    remote, local, services = cluster
+    clear_graph_caches(remote)
+    for c in [getattr(sh, "_cache") for sh in remote.shards]:
+        c.dedup_ids = c.dedup_bytes_saved = 0
+    dup = np.asarray([42] * 50 + [43, 44], np.uint64)
+    n_calls = _op_total(services, "get_dense_feature")
+    np.testing.assert_array_equal(
+        remote.get_dense_feature(dup, ["feat"]),
+        local.get_dense_feature(dup, ["feat"]),
+    )
+    # one residual RPC per owner shard at most, despite 52 requested rows
+    assert _op_total(services, "get_dense_feature") - n_calls <= 2
+    st = graph_cache_stats(remote)
+    assert st["dedup_ids"] == 49
+    assert st["dedup_bytes_saved"] == 49 * 8 * 4  # feat_dim=8 f32 rows
+
+
+def test_negative_entries(cluster):
+    """Absent ids are cached too (as the deterministic values the server
+    returns for them) — repeated misses of a missing id cost zero RPCs."""
+    remote, local, services = cluster
+    clear_graph_caches(remote)
+    owner = remote.shards[int(MISSING % np.uint64(2))]
+    first = owner.lookup([MISSING])  # prime (also the epoch handshake)
+    assert int(first[0]) == -1
+    before = _op_total(services, "lookup")
+    out = owner.lookup([MISSING])
+    assert int(out[0]) == -1
+    assert _op_total(services, "lookup") == before
+    # dense rows of a missing id: zeros, cached
+    z1 = remote.get_dense_feature([MISSING], ["feat"])
+    before = _op_total(services, "get_dense_feature")
+    z2 = remote.get_dense_feature([MISSING], ["feat"])
+    np.testing.assert_array_equal(z1, z2)
+    assert (np.asarray(z1) == 0).all()
+    assert _op_total(services, "get_dense_feature") == before
+
+
+def test_epoch_bump_invalidates(cluster):
+    remote, local, services = cluster
+    clear_graph_caches(remote)
+    remote.get_dense_feature(IDS, ["feat"])  # warm
+    sh0 = remote.shards[0]
+    epoch_before = services[0].store.graph_epoch
+    services[0].store.bump_epoch()
+    assert sh0.refresh_epoch() == epoch_before + 1
+    before = _op_total(services, "get_dense_feature")
+    np.testing.assert_array_equal(
+        remote.get_dense_feature(IDS, ["feat"]),
+        local.get_dense_feature(IDS, ["feat"]),
+    )
+    # shard 0's entries were flushed → it re-fetched; values still exact
+    assert _op_total(services, "get_dense_feature") > before
+    assert sh0._cache.invalidations >= 1
+    # a stats() poll observes the epoch too (no refresh_epoch needed)
+    services[0].store.bump_epoch()
+    d = sh0.stats()
+    assert d["graph_epoch"] == epoch_before + 2
+    assert sh0._cache.epoch == epoch_before + 2
+
+
+def test_old_server_without_graph_epoch_degrades_to_cache_forever(cluster):
+    """A server predating the graph_epoch field (its `stats` JSON lacks
+    it) reads as epoch 0 = cache-forever — correct for its immutable
+    store, and refresh_epoch() must not flush anything."""
+    remote, local, services = cluster
+    svc = services[0]
+    orig = svc.dispatch
+
+    def old_dispatch(op, a):
+        out = orig(op, a)
+        if op == "stats":
+            d = json.loads(out[0])
+            d.pop("graph_epoch", None)
+            out = [json.dumps(d)]
+        return out
+
+    svc.dispatch = old_dispatch
+    try:
+        sh0 = remote.shards[0]
+        sh0._cache.clear()
+        sh0._cache.epoch = None
+        sh0._epoch_checked = False
+        assert sh0.refresh_epoch() == 0
+        own = IDS[IDS % np.uint64(2) == 0]
+        np.testing.assert_array_equal(
+            sh0.get_dense_feature(own, ["feat"]),
+            local.shards[0].get_dense_feature(own, ["feat"]),
+        )
+        before = svc.op_counts.get("get_dense_feature", 0)
+        inval_before = sh0._cache.invalidations
+        sh0.refresh_epoch()  # still no field → still epoch 0 → no flush
+        sh0.get_dense_feature(own, ["feat"])
+        assert svc.op_counts.get("get_dense_feature", 0) == before
+        assert sh0._cache.invalidations == inval_before
+    finally:
+        del svc.dispatch  # restore the class method
+
+
+def test_minibatch_parity_cached_vs_uncached_both_lanes(cluster, monkeypatch):
+    """The acceptance contract: cached and uncached remote lanes produce
+    bit-identical MiniBatches under the same seeds, on the fused AND the
+    EULER_TPU_FUSED_PLAN=0 per-op paths."""
+    remote, local, services = cluster
+
+    def batch(flow_cls, kwargs, fused, cached, seed=11):
+        monkeypatch.setenv("EULER_TPU_FUSED_PLAN", "1" if fused else "0")
+        for sh in remote.shards:
+            sh._cache = (
+                ReadCache(1 << 20) if cached else None
+            )
+            sh._epoch_checked = False
+        roots = local.sample_node(16, rng=np.random.default_rng(3))
+        flow = flow_cls(
+            remote, ["feat"], label_feature="label",
+            rng=np.random.default_rng(seed), **kwargs,
+        )
+        out = [flow.query(roots)]
+        # second batch exercises the WARM path (hits + coverage skip)
+        flow.rng = np.random.default_rng(seed)
+        out.append(flow.query(roots))
+        return out
+
+    for flow_cls, kwargs in (
+        (FullNeighborDataFlow, dict(num_hops=2, max_degree=5, gcn_norm=True)),
+        (SageDataFlow, dict(fanouts=[3, 3])),
+    ):
+        ref_cold, ref_warm = batch(flow_cls, kwargs, fused=True, cached=False)
+        for fused in (True, False):
+            got_cold, got_warm = batch(flow_cls, kwargs, fused, cached=True)
+            for ref, got in ((ref_cold, got_cold), (ref_warm, got_warm)):
+                for a, b in zip(ref.feats, got.feats):
+                    np.testing.assert_array_equal(
+                        np.asarray(a), np.asarray(b)
+                    )
+                np.testing.assert_array_equal(
+                    np.asarray(ref.labels), np.asarray(got.labels)
+                )
+                for ba, bb in zip(ref.blocks, got.blocks):
+                    np.testing.assert_array_equal(
+                        np.asarray(ba.edge_w), np.asarray(bb.edge_w)
+                    )
+                    if ba.mask is not None:
+                        np.testing.assert_array_equal(
+                            np.asarray(ba.mask), np.asarray(bb.mask)
+                        )
+    # restore shared fixture state
+    for sh in remote.shards:
+        sh._cache = ReadCache.from_env()
+        sh._epoch_checked = False
+
+
+def test_eviction_bound_under_tiny_budget():
+    cache = ReadCache(budget_bytes=4096, stripes=2)
+    key = ("dense", ("feat",))
+    for lo in range(0, 4000, 100):
+        ids = np.arange(lo, lo + 100, dtype=np.uint64)
+        cache.fetch(
+            key, ids,
+            lambda miss: [np.ones((len(miss), 8), np.float32)],
+        )
+    assert cache.nbytes <= 4096
+    assert cache.evictions > 0
+    # LRU: the most recently touched ids survive
+    recent = np.arange(3990, 4000, dtype=np.uint64)
+    assert cache.covers(key, recent) or cache.evictions > 3000
+
+
+def test_oversized_entry_not_cached():
+    cache = ReadCache(budget_bytes=1024, stripes=4)  # 256 B per stripe
+    key = ("dense", ("wide",))
+    out = cache.fetch(
+        key, np.asarray([1], np.uint64),
+        lambda miss: [np.ones((len(miss), 512), np.float32)],  # 2 KiB row
+    )
+    np.testing.assert_array_equal(out[0], np.ones((1, 512), np.float32))
+    assert cache.nbytes == 0  # a row bigger than a stripe never thrashes
+
+
+def test_thread_hammer_race(cluster):
+    """8 threads × overlapping id sets: every result exact, no torn
+    blocks, byte budget respected."""
+    remote, local, services = cluster
+    clear_graph_caches(remote)
+    truth = {
+        k: local.get_dense_feature(
+            np.arange(1, 61, dtype=np.uint64), ["feat"]
+        )
+        for k in (0,)
+    }[0]
+    errors = []
+
+    def worker(k):
+        rng = np.random.default_rng(k)
+        try:
+            for _ in range(30):
+                sel = rng.integers(0, 60, size=40)
+                ids = np.arange(1, 61, dtype=np.uint64)[sel]
+                out = remote.get_dense_feature(ids, ["feat"])
+                np.testing.assert_array_equal(out, truth[sel])
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=worker, args=(k,)) for k in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    st = graph_cache_stats(remote)
+    assert st["bytes"] <= st["budget_bytes"]
+    assert st["hits"] > 0
+
+
+def test_kill_switch(cluster, monkeypatch):
+    monkeypatch.setenv("EULER_TPU_READ_CACHE", "0")
+    assert ReadCache.from_env() is None
+
+
+def test_device_feature_cache_refresh_rows():
+    """Residual re-staging: after a feature mutation + epoch bump, only
+    the touched rows are refetched into the device table."""
+    jnp = pytest.importorskip("jax.numpy")
+    from euler_tpu.estimator import DeviceFeatureCache
+
+    g = random_graph(num_nodes=50, out_degree=4, feat_dim=4, seed=8)
+    cache = DeviceFeatureCache(g, ["feat"])
+    store = g.shards[0]
+    rows = np.asarray([3, 7], np.int64)
+    store.arrays["nf_dense_0"][rows] = 123.0
+    store.bump_epoch()
+    assert store.graph_epoch == 1
+    n = cache.refresh_rows(g, rows)
+    assert n == 2
+    np.testing.assert_allclose(
+        np.asarray(cache.table)[rows + 1], 123.0
+    )
+    # untouched rows keep their original values
+    other = np.asarray(cache.table)[1]
+    np.testing.assert_allclose(
+        other, np.asarray(g.get_dense_by_rows([0], ["feat"]))[0]
+    )
