@@ -23,12 +23,40 @@ the C++ engine's alias tables draw from (graph_engine.cc `AliasTable`).
 Batches from a weighted graph carry bf16 edge weights, matching the host
 weighted-lean wire (sage.py `_lean_w`) leaf-for-leaf.
 
-Memory: the padded adjacency costs (N+1)·Dmax·4 bytes of HBM (row+1
-encoding, 0 = padding). For bounded-degree graphs this is small (200k
-nodes × deg 15 ≈ 12 MB); power-law graphs with hub nodes blow the table
-up — `max_degree` (default 512) is a GUARD that fails construction
-loudly in that case (truncating would bias sampling), and such graphs
-keep the host flows.
+Memory — two layouts:
+
+- `layout="dense"`: padded adjacency, (N+1)·Dmax·4 bytes of HBM (row+1
+  encoding, 0 = padding). For bounded-degree graphs this is small (200k
+  nodes × deg 15 ≈ 12 MB); power-law graphs with hub nodes blow the
+  table up — `max_degree` (default 512) is a GUARD that fails
+  construction loudly in that case (truncating would bias sampling).
+- `layout="paged"`: ragged neighbor PAGES — fixed-size pages (default
+  16 slots) in a flat HBM buffer plus a per-node page table
+  (`page_start`), so a hub node spans ⌈deg/P⌉ pages instead of widening
+  every row: HBM ∝ edges (+ N·4 B of page table), no `max_degree`
+  failure mode. The access shape is the Ragged Paged Attention
+  indirection (PAPERS.md, arxiv 2604.15464); the page reads run through
+  the `paged_gather`/`paged_cdf_count` entry points in
+  ops/pallas_kernels.py (Pallas on request, jitted jnp reference as the
+  `auto` fallback and A/B oracle).
+
+`layout="auto"` (the default) picks dense when the graph's max degree
+fits `max_degree` and paged otherwise, for the SAGE-family flows;
+flows that need the dense planes (walk bias, per-relation type planes,
+layerwise scatter) always stage dense.
+
+Weighted draws in BOTH layouts invert the same per-row uint32-quantized
+CDF staged at construction (exact f64 cumsum per row, quantized once),
+so paged and dense lanes draw bit-identical neighbors under the same
+keys — pinned by tests/test_paged_flow.py. The parity story stays one
+lane wide.
+
+Remote graphs stage too: when the shards are RemoteShard handles the
+construction sweep enumerates each shard's node table over the wire
+(`ids_by_rows`) and walks the same chunked get_full_neighbor +
+lookup_rows path through the Graph facade — deterministic verbs, so the
+PR-5 client ReadCache serves repeats. Per-step traffic afterwards is
+zero, exactly like the local staging.
 
 Staging cost (one-time, at construction): the chunked
 get_full_neighbor + lookup_rows sweep runs at ~3.7M edges/s on one host
@@ -46,6 +74,84 @@ import numpy as np
 from .base import Block, MiniBatch
 
 _STAGE_CHUNK = 16384
+# host-side staging temp budget: the chunked get_full_neighbor sweep
+# allocates [chunk, cap] padded arrays — on power-law graphs cap is the
+# hub degree, so the chunk length adapts to keep the temp bounded
+_STAGE_TEMP_BYTES = 64 << 20
+_U32_MAX = np.uint32(0xFFFFFFFF)
+
+
+def _node_table(graph):
+    """(ids u64, weights f64, types i32) for every node, shard-major —
+    the same row order as Graph.lookup_rows. Local shards read their
+    columns directly; remote shards sweep the `ids_by_rows` verb in row
+    chunks (deterministic → served by the client ReadCache on repeats).
+    """
+    shards = graph.shards
+    if all(
+        hasattr(s, "node_ids") and hasattr(s, "node_weights")
+        for s in shards
+    ):
+        return (
+            np.concatenate([np.asarray(s.node_ids) for s in shards]),
+            np.concatenate(
+                [np.asarray(s.node_weights, np.float64) for s in shards]
+            ),
+            np.concatenate(
+                [np.asarray(s.node_types, np.int32) for s in shards]
+            ),
+        )
+    ids_p, wn_p, nt_p = [], [], []
+    for sh in shards:
+        n = int(sh.num_nodes)
+        for lo in range(0, n, _STAGE_CHUNK):
+            rows = np.arange(lo, min(lo + _STAGE_CHUNK, n), dtype=np.int64)
+            try:
+                i, w, t = sh.ids_by_rows(rows)
+            except RuntimeError as e:
+                if "unknown op" in str(e):
+                    raise ValueError(
+                        "remote device staging needs servers speaking the "
+                        "ids_by_rows verb — upgrade the shard servers or "
+                        "keep the host flows"
+                    ) from e
+                raise
+            ids_p.append(np.asarray(i, np.uint64))
+            wn_p.append(np.asarray(w, np.float64))
+            nt_p.append(np.asarray(t, np.int32))
+    if not ids_p:
+        return (
+            np.empty(0, np.uint64),
+            np.empty(0, np.float64),
+            np.empty(0, np.int32),
+        )
+    return np.concatenate(ids_p), np.concatenate(wn_p), np.concatenate(nt_p)
+
+
+def _quantize_rows(wblock: np.ndarray, valid: np.ndarray) -> np.ndarray:
+    """Per-row uint32-quantized CDF over the compacted weight block —
+    the ONE quantization both layouts stage, so their draws invert
+    identical integers. Exact f64 cumsum per row; invalid slots and
+    zero-total rows fill 0xFFFFFFFF (never drawn below r == MAX, which
+    the callers' deg-1 clamp absorbs)."""
+    cum = np.cumsum(
+        np.where(valid, wblock, 0.0).astype(np.float64), axis=1
+    )
+    total = cum[:, -1:]
+    safe = np.maximum(total, np.finfo(np.float64).tiny)
+    q = np.floor(cum / safe * np.float64(2**32 - 1))
+    q = q.astype(np.uint64).astype(np.uint32)
+    return np.where(valid & (total > 0), q, _U32_MAX)
+
+
+def _segment_arange(counts: np.ndarray) -> np.ndarray:
+    """[0..c0), [0..c1), ... concatenated (vectorized per-segment iota)."""
+    counts = np.asarray(counts, np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    ends = np.cumsum(counts)
+    return np.arange(total) - np.repeat(ends - counts, counts)
 
 
 class DeviceGraphTables:
@@ -130,6 +236,11 @@ class DeviceGraphTables:
             )
         return jax.random.randint(key, (count,), 0, self.num_edges)
 
+    # SAGE-family tables draw only through _draw_neighbors and may stage
+    # paged; flows that read the dense planes directly (walk bias,
+    # per-relation type planes, layerwise scatter) override this False
+    _PAGED_OK = True
+
     def __init__(
         self,
         graph,
@@ -139,19 +250,26 @@ class DeviceGraphTables:
         root_node_type: int = -1,
         mesh=None,
         stage_types: bool = False,
+        layout: str = "auto",
+        page_size: int = 16,
     ):
         """roots_pool: optional node ids to sample roots from (e.g. a
         train split); root_node_type restricts root draws to one node
         type instead (host sample_node(node_type) parity; ignored when a
         pool is given); default is every node. Root draws are proportional
         to node weights either way (uniform when weights are constant —
-        host sample_node parity). max_degree is a guard on the staged
-        adjacency width ((N+1)·Dmax·4 bytes of HBM): construction raises
-        when the graph's true max degree exceeds it — truncation would
-        bias sampling, so it is never done silently. The default (512)
-        makes a hub-heavy power-law graph fail loudly instead of
-        allocating an N×hub_degree table; raise it explicitly after
-        checking the memory math.
+        host sample_node parity). max_degree is a guard on the DENSE
+        staged adjacency width ((N+1)·Dmax·4 bytes of HBM): construction
+        raises when the graph's true max degree exceeds it — truncation
+        would bias sampling, so it is never done silently.
+
+        layout: "dense" | "paged" | "auto". "auto" (default) picks dense
+        while the max degree fits `max_degree` and otherwise stages the
+        ragged paged layout (HBM ∝ edges; hub nodes span multiple
+        fixed-size pages), so power-law graphs train on the device lane
+        instead of raising. page_size must divide 128 (one page per DMA
+        lane row). Paged and dense draws are bit-identical under the
+        same keys (shared quantized-CDF inversion).
 
         mesh: a jax.sharding.Mesh for data-parallel training — sampled
         batch leaves are sharding-constrained along the mesh's data axis
@@ -160,30 +278,70 @@ class DeviceGraphTables:
         Values are identical to the unsharded program for the same key.
         """
         self.mesh = mesh
-        if not all(
+        local = all(
             hasattr(s, "node_ids") and hasattr(s, "node_weights")
             for s in graph.shards
+        )
+        if not local and not all(
+            hasattr(s, "call") for s in graph.shards
         ):
             raise ValueError(
-                "device flows stage the full adjacency host-side and "
-                "need local shards (remote graphs keep the host flows)"
+                "device flows stage the adjacency host-side and need "
+                "local shards or remote shards (wire staging)"
             )
-        ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
-        self._stage_adjacency(graph, ids, edge_types, max_degree, stage_types)
-        self._stage_nodes(graph, ids, roots_pool, root_node_type)
+        ids, wn, nt = _node_table(graph)
+        self._stage_adjacency(
+            graph, ids, edge_types, max_degree, stage_types,
+            layout=layout, page_size=page_size,
+        )
+        self._stage_nodes(graph, ids, wn, nt, roots_pool, root_node_type)
+
+    def _stage_degrees(self, graph, ids, edge_types) -> np.ndarray:
+        """Per-node total degree, swept in chunks (one bounded RPC per
+        chunk on remote graphs; degree_sum is ReadCache-deterministic)."""
+        degs = np.zeros(len(ids), np.int64)
+        for lo in range(0, len(ids), _STAGE_CHUNK):
+            sub = ids[lo : lo + _STAGE_CHUNK]
+            degs[lo : lo + len(sub)] = graph.degree_sum(sub, edge_types)
+        return degs
 
     def _stage_adjacency(
-        self, graph, ids, edge_types, max_degree: int, stage_types: bool
+        self,
+        graph,
+        ids,
+        edge_types,
+        max_degree: int,
+        stage_types: bool,
+        layout: str = "auto",
+        page_size: int = 16,
     ):
-        n = len(ids)
-        dmax = int(graph.max_degree(ids, edge_types))
-        if dmax > max_degree:
+        if layout not in ("auto", "dense", "paged"):
+            raise ValueError(f"unknown layout {layout!r}")
+        degs = self._stage_degrees(graph, ids, edge_types)
+        dmax = max(int(degs.max(initial=0)), 1)
+        paged_ok = self._PAGED_OK and not stage_types
+        if layout == "auto":
+            layout = "paged" if (dmax > max_degree and paged_ok) else "dense"
+        if layout == "paged" and not paged_ok:
+            raise ValueError(
+                f"{type(self).__name__} reads the dense adjacency planes "
+                "directly (bias/type/layerwise math) — the paged layout "
+                "serves the SAGE-family flows only"
+            )
+        if layout == "dense" and dmax > max_degree:
             raise ValueError(
                 f"graph max degree {dmax} exceeds max_degree={max_degree}; "
-                "the staged adjacency would cost (N+1)*"
-                f"{dmax}*4 bytes — raise the cap explicitly or use the "
-                "host flows"
+                f"the dense staged adjacency would cost (N+1)*{dmax}*4 "
+                "bytes — use the paged device lane instead "
+                "(layout='paged', or layout='auto' which selects it "
+                "automatically: fixed-size neighbor pages, HBM ∝ edges), "
+                "or raise the cap explicitly after the memory math"
             )
+        self.layout = layout
+        if layout == "paged":
+            self._stage_paged(graph, ids, degs, edge_types, page_size)
+            return
+        n = len(ids)
         adj = np.zeros((n + 1, dmax), dtype=np.int32)
         deg = np.zeros(n + 1, dtype=np.int32)
         wtab = np.zeros((n + 1, dmax), dtype=np.float32)
@@ -224,23 +382,139 @@ class DeviceGraphTables:
         self.deg = jax.device_put(deg)
         self.unit_w = unit_w
         # weighted graphs stage the RAW weight rows (exact values for
-        # edge_w and bias math); the per-row CDF is a cheap [W, D] cumsum
-        # on the gathered rows at draw time — one table, no f32
-        # cancellation from storing cumulative sums
+        # edge_w and bias math) plus the per-row quantized CDF — the ONE
+        # inversion table shared bit-for-bit with the paged layout
+        # (trailing f64 cumsum at staging; device keeps uint32)
         self.wtab = None if unit_w else jax.device_put(wtab)
+        if unit_w:
+            self.qtab = None
+        else:
+            valid = (
+                np.arange(dmax)[None, :] < deg[:, None]
+            )
+            self.qtab = jax.device_put(_quantize_rows(wtab, valid))
         self.ttab = jax.device_put(ttab) if ttab is not None else None
         self.max_deg = dmax
 
-    def _stage_nodes(self, graph, ids, roots_pool, root_node_type: int):
+    def _stage_paged(self, graph, ids, degs, edge_types, page_size: int):
+        """Ragged paged staging: compacted neighbor entries (same order
+        as the dense compaction, so draws land on the same slots) packed
+        into fixed-size pages in one flat buffer; per-node page table in
+        `page_start`. HBM ∝ edges — no max_degree failure mode."""
+        from euler_tpu.ops.pallas_kernels import PAGE_LANES, _as_lane_rows
+
+        P = int(page_size)
+        if P <= 0 or PAGE_LANES % P:
+            raise ValueError(
+                f"page_size must divide {PAGE_LANES} (one page per DMA "
+                f"lane row); got {P}"
+            )
+        n = len(ids)
+        deg = np.zeros(n + 1, dtype=np.int32)
+        strength = np.zeros(n + 1, dtype=np.float64)
+        unit_w = True
+        vals_p, w_p, q_p = [], [], []
+        lo = 0
+        while lo < n:
+            # temp budget: [chunk, cap] padded host arrays per sweep step
+            cap_hint = max(int(degs[lo : lo + _STAGE_CHUNK].max(initial=1)), 1)
+            chunk = max(
+                256, min(_STAGE_CHUNK, _STAGE_TEMP_BYTES // (cap_hint * 8))
+            )
+            sub = ids[lo : lo + chunk]
+            cap = max(int(degs[lo : lo + len(sub)].max(initial=0)), 1)
+            nbr, w, _, mask, _ = graph.get_full_neighbor(
+                sub, edge_types, max_degree=cap
+            )
+            unit_w = unit_w and bool(np.all(w[mask] == 1.0))
+            rows = graph.lookup_rows(nbr.ravel()).reshape(nbr.shape)
+            blk0 = np.where(mask & (rows >= 0), rows + 1, 0).astype(np.int32)
+            order = np.argsort(blk0 == 0, axis=1, kind="stable")
+            block = np.take_along_axis(blk0, order, axis=1)
+            wblk = np.take_along_axis(
+                np.where(blk0 > 0, w, 0.0).astype(np.float32), order, axis=1
+            )
+            d = (block > 0).sum(axis=1).astype(np.int32)
+            st = wblk.sum(axis=1, dtype=np.float64)
+            d[st <= 0.0] = 0  # zero-strength rows are unsampleable
+            sl = slice(1 + lo, 1 + lo + len(sub))
+            deg[sl] = d
+            strength[sl] = st
+            valid = np.arange(block.shape[1])[None, :] < d[:, None]
+            vals_p.append(block[valid])
+            w_p.append(wblk[valid])
+            q_p.append(_quantize_rows(wblk, valid)[valid])
+            lo += len(sub)
+        self._out_strength = strength
+        npages = -(-deg.astype(np.int64) // P)  # ceil(deg/P); 0 for deg 0
+        ps = np.zeros(n + 2, dtype=np.int64)
+        ps[1:] = np.cumsum(npages)
+        total_pages = max(int(ps[-1]), 1)
+        flat = np.zeros(total_pages * P, dtype=np.int32)
+        flat_w = np.zeros(total_pages * P, dtype=np.float32)
+        flat_q = np.full(total_pages * P, _U32_MAX, dtype=np.uint32)
+        # entries of node r (row+1 space) land at ps[r]*P + [0, deg_r)
+        dest = np.repeat(ps[:-1] * P, deg) + _segment_arange(deg)
+        if len(dest):
+            flat[dest] = np.concatenate(vals_p)
+            flat_w[dest] = np.concatenate(w_p)
+            flat_q[dest] = np.concatenate(q_p)
+        self.pages2d = _as_lane_rows(jnp.asarray(flat))
+        self.page_start = jax.device_put(ps.astype(np.int32))
+        self.deg = jax.device_put(deg)
+        self.unit_w = unit_w
+        if unit_w:
+            self.page_w2d = self.page_q2d = self.page_bound = None
+        else:
+            self.page_w2d = _as_lane_rows(jnp.asarray(flat_w))
+            self.page_q2d = _as_lane_rows(jnp.asarray(flat_q))
+            # per-page boundary = the page's last valid quantized-CDF
+            # value (pads are U32_MAX, and a node's final page ends at
+            # U32_MAX anyway, so a plain per-page max is exact)
+            self.page_bound = jax.device_put(
+                flat_q.reshape(total_pages, P).max(axis=1)
+            )
+        self.page_size = P
+        # clamp caps for masked draws: a trailing degree-0 node's
+        # page_start equals total_pages, and its (deg>0-masked) gather
+        # index must still stay inside the buffers — XLA clips gathers,
+        # but the kernel DMAs must never be handed an OOB row
+        self._page_cap = total_pages - 1
+        self._slot_cap = total_pages * P - 1
+        self.max_pages = int(npages.max(initial=0))
+        # binary-search depth over a node's page range (static at trace)
+        self._search_iters = max(1, int(self.max_pages).bit_length() + 1)
+        self.max_deg = max(int(deg.max(initial=0)), 1)
+        # dense planes absent on purpose: flows that need them are gated
+        # by _PAGED_OK at staging time
+        self.adj = self.wtab = self.qtab = self.ttab = None
+
+    @property
+    def _kimpl(self) -> str:
+        """Paged-kernel impl derived from the global pallas mode: 'off'
+        rides the jitted jnp reference, 'interpret'/'pallas' are the
+        explicit kernel forms, 'auto' defers to the kernels' own
+        measured-boundary auto (currently the reference — see
+        ops/PALLAS_BENCH.md)."""
+        from euler_tpu.ops import pallas_mode
+
+        mode = pallas_mode()
+        if mode == "off":
+            return "xla"
+        if mode in ("interpret", "pallas"):
+            return mode
+        return "auto"
+
+    def _stage_nodes(
+        self, graph, ids, wn, nt, roots_pool, root_node_type: int
+    ):
         n = len(ids)
         # weight-proportional root draws (host sample_node parity): a
         # uint32-quantized CDF, binary-searched on device — over all nodes,
         # or over roots_pool's members when a pool restricts the draw.
         # Integer quantization keeps adjacent cum values exact where f32
         # cumsum over >1e6 nodes would swallow small weights.
-        wn = np.concatenate(
-            [np.asarray(s.node_weights, dtype=np.float64) for s in graph.shards]
-        )
+        wn = np.asarray(wn, dtype=np.float64)
         # global (unrestricted) node CDF — negative sampling draws from
         # ALL nodes even when roots are pool/type-restricted (host
         # unsupervised_batches neg_type=-1 parity)
@@ -258,10 +532,9 @@ class DeviceGraphTables:
                 raise ValueError("roots_pool contains unknown node ids")
             wn = wn[pool_rows]
         elif root_node_type >= 0:
-            nt = np.concatenate(
-                [np.asarray(s.node_types) for s in graph.shards]
-            )
-            pool_rows = np.nonzero(nt == root_node_type)[0].astype(np.int64)
+            pool_rows = np.nonzero(
+                np.asarray(nt) == root_node_type
+            )[0].astype(np.int64)
             if not len(pool_rows):
                 raise ValueError(
                     f"no nodes of type {root_node_type} to sample roots from"
@@ -378,19 +651,27 @@ class DeviceGraphTables:
         """[W] rows → ([W·k] rows, [W·k] bf16 weights or None, [W, k] slot idx).
 
         Uniform graphs draw a slot index directly; weighted graphs invert
-        the per-row cumulative CDF. Padding rows (0) yield padding.
+        the per-row uint32-quantized CDF staged at construction — the
+        SAME integers in both layouts, so the paged lane below draws
+        bit-identical neighbors under the same key. Padding rows (0)
+        yield padding.
         """
+        if getattr(self, "layout", "dense") == "paged":
+            return self._draw_neighbors_paged(cur, key, k)
         width = cur.shape[0]
         deg = self.deg[cur]
-        u = jax.random.uniform(key, (width, k))
         if self.unit_w:
+            u = jax.random.uniform(key, (width, k))
             idx = (u * deg[:, None]).astype(jnp.int32)
             ew = None
         else:
-            w = self.wtab[cur]  # [W, D] exact weights
-            cw = jnp.cumsum(w, axis=1)
-            scaled = u * cw[:, -1][:, None]
-            idx = (cw[:, None, :] <= scaled[:, :, None]).sum(axis=-1)
+            r = jax.random.bits(key, (width, k), dtype=jnp.uint32)
+            qrow = self.qtab[cur]  # [W, D] uint32 per-row CDF
+            idx = (
+                (qrow[:, None, :] <= r[:, :, None])
+                .sum(axis=-1)
+                .astype(jnp.int32)
+            )
         idx = jnp.minimum(idx, jnp.maximum(deg[:, None] - 1, 0))
         nbr = jnp.where(
             deg[:, None] > 0, self.adj[cur[:, None], idx], 0
@@ -398,7 +679,57 @@ class DeviceGraphTables:
         if not self.unit_w:
             # exact staged weight of the drawn edge (zero on padded slots)
             ew = (
-                jnp.take_along_axis(w, idx, axis=1)
+                jnp.take_along_axis(self.wtab[cur], idx, axis=1)
+                .reshape(-1)
+                .astype(jnp.bfloat16)
+            )
+        return nbr, ew, idx
+
+    def _draw_neighbors_paged(self, cur, key, k: int):
+        """Paged twin of _draw_neighbors: two-level quantized-CDF
+        inversion (page-boundary binary search + in-page count) and
+        neighbor/weight gathers through the page indirection — identical
+        integers to the dense inversion, different memory layout. The
+        page reads route through ops/pallas_kernels entry points."""
+        from euler_tpu.ops.pallas_kernels import (
+            paged_cdf_count,
+            paged_gather,
+            paged_page_search,
+        )
+
+        width = cur.shape[0]
+        deg = self.deg[cur]
+        ps = self.page_start[cur]
+        P = self.page_size
+        impl = self._kimpl
+        if self.unit_w:
+            u = jax.random.uniform(key, (width, k))
+            idx = (u * deg[:, None]).astype(jnp.int32)
+            ew = None
+        else:
+            r = jax.random.bits(key, (width, k), dtype=jnp.uint32)
+            npages = self.page_start[cur + 1] - ps
+            pg = paged_page_search(
+                self.page_bound, ps, npages, r, self._search_iters
+            )
+            pgc = jnp.minimum(pg, jnp.maximum(npages[:, None] - 1, 0))
+            page = jnp.minimum(ps[:, None] + pgc, self._page_cap)
+            cnt = paged_cdf_count(self.page_q2d, page, r, P, impl=impl)
+            idx = pgc * P + cnt
+        idx = jnp.minimum(idx, jnp.maximum(deg[:, None] - 1, 0))
+        fidx = jnp.minimum(ps[:, None] * P + idx, self._slot_cap)
+        nbr = jnp.where(
+            deg[:, None] > 0,
+            paged_gather(self.pages2d, fidx, impl=impl),
+            0,
+        ).reshape(-1)
+        if not self.unit_w:
+            ew = (
+                jnp.where(
+                    deg[:, None] > 0,
+                    paged_gather(self.page_w2d, fidx, impl=impl),
+                    0.0,
+                )
                 .reshape(-1)
                 .astype(jnp.bfloat16)
             )
@@ -427,14 +758,22 @@ class DeviceSageFlow(DeviceGraphTables):
         root_node_type: int = -1,
         mesh=None,
         with_hop_ids: bool = False,
+        layout: str = "auto",
+        page_size: int = 16,
     ):
         """with_hop_ids=True ships per-hop int32 node ids in the batch —
         what id-embedding models (ShallowEncoder with max_id) consume.
         The host LEAN wire must omit hop_ids (they cost wire bytes); on
         device they are a free node_id gather, so id-embedding models
-        run through the device flow at no extra cost."""
+        run through the device flow at no extra cost.
+
+        layout="auto" stages the dense padded adjacency while the max
+        degree fits `max_degree` and the ragged paged layout otherwise
+        (power-law graphs; HBM ∝ edges) — draws are bit-identical either
+        way under the same keys."""
         super().__init__(
-            graph, edge_types, max_degree, roots_pool, root_node_type, mesh
+            graph, edge_types, max_degree, roots_pool, root_node_type, mesh,
+            layout=layout, page_size=page_size,
         )
         self.fanouts = [int(k) for k in fanouts]
         self.batch_size = int(batch_size)
@@ -520,10 +859,13 @@ class DeviceUnsupSageFlow(DeviceSageFlow):
         root_node_type: int = -1,
         mesh=None,
         with_hop_ids: bool = False,
+        layout: str = "auto",
+        page_size: int = 16,
     ):
         super().__init__(
             graph, fanouts, batch_size, None, edge_types, max_degree,
             roots_pool, root_node_type, mesh, with_hop_ids=with_hop_ids,
+            layout=layout, page_size=page_size,
         )
         self.num_negs = int(num_negs)
 
@@ -559,6 +901,8 @@ class DeviceWalkFlow(DeviceGraphTables):
     path is gated to max degree ≤ 64 (guarded at construction).
     """
 
+    _PAGED_OK = False  # _walk_step reads the dense adj plane directly
+
     def __init__(
         self,
         graph,
@@ -573,9 +917,11 @@ class DeviceWalkFlow(DeviceGraphTables):
         roots_pool: np.ndarray | None = None,
         root_node_type: int = -1,
         mesh=None,
+        layout: str = "auto",
     ):
         super().__init__(
-            graph, edge_types, max_degree, roots_pool, root_node_type, mesh
+            graph, edge_types, max_degree, roots_pool, root_node_type, mesh,
+            layout=layout,
         )
         self.batch_size = int(batch_size)
         self.walk_len = int(walk_len)
@@ -680,8 +1026,8 @@ class _FlatEdgeFlow(DeviceGraphTables):
         self.batch_size = int(batch_size)
         self.num_negs = int(num_negs)
         self._stage_flat_edges(graph, edge_type, stage_er=stage_er)
-        ids = np.concatenate([np.asarray(s.node_ids) for s in graph.shards])
-        self._stage_nodes(graph, ids, None, -1)
+        ids, wn, nt = _node_table(graph)
+        self._stage_nodes(graph, ids, wn, nt, None, -1)
 
 
 class DeviceEdgeFlow(_FlatEdgeFlow):
@@ -764,6 +1110,8 @@ class DeviceRelationFlow(DeviceGraphTables):
     model consumes, with dense features gathered in-flow from an HBM
     feature table (RelMiniBatch has no rows-mode hydration path).
     """
+
+    _PAGED_OK = False  # typed draws mask the dense type plane
 
     def __init__(
         self,
@@ -874,6 +1222,8 @@ class DeviceLayerwiseFlow(DeviceGraphTables):
     in-flow-gathered features).
     """
 
+    _PAGED_OK = False  # the layer scatter reads the dense adj/w planes
+
     def __init__(
         self,
         graph,
@@ -971,10 +1321,11 @@ class DeviceGaeFlow(DeviceSageFlow):
     """
 
     def __init__(self, graph, fanouts, batch_size, edge_types=None,
-                 max_degree: int = 512, mesh=None):
+                 max_degree: int = 512, mesh=None, layout: str = "auto",
+                 page_size: int = 16):
         super().__init__(
             graph, fanouts, batch_size, None, edge_types, max_degree,
-            mesh=mesh,
+            mesh=mesh, layout=layout, page_size=page_size,
         )
         self._stage_edge_src_cdf()
 

@@ -226,6 +226,7 @@ class RemoteShard:
         "get_meta",
         "get_sparse_feature",
         "get_top_k_neighbor",
+        "ids_by_rows",
         "lookup",
         "node2vec_step",
         "node_ids_by_condition",
@@ -522,6 +523,22 @@ class RemoteShard:
             ("node_type",), ids,
             lambda miss: [self.call("node_type", [miss])[0]],
         )[0]
+
+    def ids_by_rows(self, rows):
+        """Local rows → (ids u64, weights f64, types i32): the inverse of
+        lookup, swept by remote device-resident staging to enumerate this
+        shard's node table. Deterministic per row → cached."""
+        rows = np.asarray(rows, np.int64)
+        c = self._cached()
+        if c is None:
+            return tuple(self.call("ids_by_rows", [rows]))
+        return tuple(
+            c.fetch(
+                ("ids_rows",),
+                rows,
+                lambda miss: self.call("ids_by_rows", [miss]),
+            )
+        )
 
     def sample_node(self, count, node_type=-1, rng=None):
         return self.call("sample_node", [count, node_type, _seed(rng)])[0]

@@ -476,6 +476,7 @@ class GraphService:
         "get_meta",
         "get_sparse_feature",
         "get_top_k_neighbor",
+        "ids_by_rows",
         "lookup",
         "node2vec_step",
         "node_ids_by_condition",
@@ -524,6 +525,32 @@ class GraphService:
             })]
         if op == "num_nodes":
             return [int(s.num_nodes)]
+        if op == "ids_by_rows":
+            # the inverse of lookup: local rows → (id, weight, type) —
+            # what remote device-resident staging sweeps to enumerate the
+            # shard's node table (out-of-range rows → DEFAULT_ID/0/-1,
+            # the standard missing-row triple). Deterministic, so client
+            # read caches may serve it.
+            from euler_tpu.graph.store import DEFAULT_ID
+
+            rows = np.asarray(a[0], np.int64)
+            ok = (rows >= 0) & (rows < s.num_nodes)
+            safe = np.clip(rows, 0, max(s.num_nodes - 1, 0))
+            if s.num_nodes == 0:
+                return [
+                    np.full(len(rows), DEFAULT_ID, np.uint64),
+                    np.zeros(len(rows), np.float64),
+                    np.full(len(rows), -1, np.int32),
+                ]
+            return [
+                np.where(ok, np.asarray(s.node_ids)[safe], DEFAULT_ID),
+                np.where(
+                    ok, np.asarray(s.node_weights, np.float64)[safe], 0.0
+                ),
+                np.where(
+                    ok, np.asarray(s.node_types, np.int32)[safe], -1
+                ).astype(np.int32),
+            ]
         if op == "exec_plan":
             # fused per-shard sub-plan (SPLIT → REMOTE → MERGE parity,
             # optimizer.h:49-86): the whole compiled chain for this

@@ -11,4 +11,7 @@ from euler_tpu.estimator.estimator import (  # noqa: F401
     stack_batches,
     unsupervised_batches,
 )
-from euler_tpu.estimator.feature_cache import DeviceFeatureCache  # noqa: F401
+from euler_tpu.estimator.feature_cache import (  # noqa: F401
+    DeviceFeatureCache,
+    ResidualFetchRing,
+)

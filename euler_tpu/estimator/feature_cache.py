@@ -111,3 +111,192 @@ class DeviceFeatureCache:
 
     def hydrate_args(self, args: tuple) -> tuple:
         return tuple(self.hydrate(a) for a in args)
+
+
+class ResidualFetchRing:
+    """Double-buffered background re-stager for device-resident tables —
+    the residual lane of the paged device-sampling flow.
+
+    The device lane stages everything once at construction; afterwards
+    the only host↔wire traffic is RESIDUAL: rows invalidated by a
+    `graph_epoch` bump, or rows a caller wants re-warmed. Those fetches
+    must never stall the device, so they run on a background worker into
+    a bounded ring of host buffers (fetch job N+1 is on the wire while
+    the trainer consumes job N) and `commit()` patches finished buffers
+    into the device table between dispatches — the swap point. Against a
+    remote graph the fetch path is `get_dense_by_rows`, a deterministic
+    verb served by the PR-5 client ReadCache: staging warmed the cache,
+    so residual fetches are mostly client-side hits and
+    `stats()["residual_fetch_hit_rate"]` reports exactly that (the bench
+    remote lane's telemetry key).
+
+    Epoch handshake: `poll_epoch()` re-reads each remote shard's
+    graph_epoch via `refresh_epoch()` (which already flushes that
+    shard's ReadCache on a bump) and schedules a residual refresh of the
+    tracked rows, so the device table converges on the new epoch without
+    a full re-stage — `DeviceFeatureCache.refresh_rows` is the one-shot
+    synchronous form of the same move.
+    """
+
+    def __init__(self, cache: DeviceFeatureCache, graph, depth: int = 2):
+        import queue
+        import threading
+
+        self.cache = cache
+        self.graph = graph
+        self._jobs: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
+        self._ready: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._epochs: dict[int, int] = {}  # bounded: one entry per shard
+        # telemetry (GIL-racy increments fine — repo counter stance)
+        self.fetched_rows = 0
+        self.commits = 0
+        base = self._cache_stats()
+        self._hit_base = (
+            {"hits": base.get("hits", 0), "misses": base.get("misses", 0)}
+            if base
+            else {"hits": 0, "misses": 0}
+        )
+        self._worker = threading.Thread(
+            target=self._work, daemon=True, name="residual-fetch-ring"
+        )
+        self._worker.start()
+
+    def _cache_stats(self) -> dict | None:
+        from euler_tpu.distributed.cache import graph_cache_stats
+
+        return graph_cache_stats(self.graph)
+
+    # -- producer side ---------------------------------------------------
+
+    def prefetch(self, rows) -> bool:
+        """Schedule a residual fetch of the given global rows (row space
+        of lookup_rows, NOT row+1). Non-blocking: False when the ring is
+        full — the caller retries at the next swap point instead of
+        stalling the step."""
+        import queue
+
+        rows = np.unique(np.asarray(rows, dtype=np.int64).reshape(-1))
+        rows = rows[(rows >= 0) & (rows + 1 < self.cache.table.shape[0])]
+        if not len(rows):
+            return False
+        with self._lock:
+            try:
+                self._jobs.put_nowait(rows)
+            except queue.Full:
+                return False
+            self._inflight += 1
+        return True
+
+    def poll_epoch(self, hot_rows=None) -> bool:
+        """Re-observe each shard's graph_epoch (refresh_epoch flushes the
+        shard's ReadCache on a bump); on any bump, schedule a residual
+        refresh of `hot_rows` (default: the whole table, best-effort —
+        repeated polls converge when the ring was full). Returns True
+        when a bump was observed."""
+        bumped = False
+        for sh in getattr(self.graph, "shards", []) or []:
+            fn = getattr(sh, "refresh_epoch", None)
+            ep = int(fn()) if fn is not None else int(
+                getattr(sh, "graph_epoch", 0)
+            )
+            part = int(getattr(sh, "part", 0))
+            with self._lock:
+                last = self._epochs.get(part)
+                self._epochs[part] = ep
+            if last is not None and ep != last:
+                bumped = True
+        if bumped:
+            rows = (
+                np.arange(self.cache.table.shape[0] - 1, dtype=np.int64)
+                if hot_rows is None
+                else np.asarray(hot_rows, dtype=np.int64)
+            )
+            for lo in range(0, len(rows), 65536):
+                if not self.prefetch(rows[lo : lo + 65536]):
+                    break  # ring full: the next poll re-schedules
+        return bumped
+
+    # -- worker / consumer side ------------------------------------------
+
+    def _work(self):
+        while True:
+            rows = self._jobs.get()
+            if rows is None:
+                return
+            try:
+                vals = np.asarray(
+                    self.graph.get_dense_by_rows(
+                        rows, self.cache.feature_names
+                    ),
+                    np.float32,
+                )
+                self._ready.put((rows, vals))
+            except Exception as e:  # surfaced to the caller at commit()
+                self._ready.put((rows, e))
+
+    def commit(self) -> int:
+        """Patch every FINISHED buffer into the device table (call
+        between dispatches). Returns rows patched; re-raises the first
+        fetch error, if any."""
+        import queue
+
+        n = 0
+        err = None
+        while True:
+            try:
+                rows, vals = self._ready.get_nowait()
+            except queue.Empty:
+                break
+            with self._lock:
+                self._inflight -= 1
+            if isinstance(vals, Exception):
+                err = err or vals
+                continue
+            self.cache.table = self.cache.table.at[rows + 1].set(
+                jnp.asarray(vals, dtype=self.cache.table.dtype)
+            )
+            n += len(rows)
+        if n:
+            self.commits += 1
+            self.fetched_rows += n
+        if err is not None:
+            raise err
+        return n
+
+    def flush(self, timeout_s: float = 30.0) -> int:
+        """Wait for every in-flight fetch and commit it (test/shutdown
+        convenience — the training loop uses commit() alone)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        n = self.commit()
+        while True:
+            with self._lock:
+                idle = self._inflight == 0
+            if idle or time.monotonic() > deadline:
+                break
+            time.sleep(0.005)
+            n += self.commit()
+        return n + self.commit()
+
+    def stats(self) -> dict:
+        st = self._cache_stats() or {}
+        hits = int(st.get("hits", 0)) - self._hit_base["hits"]
+        misses = int(st.get("misses", 0)) - self._hit_base["misses"]
+        lookups = hits + misses
+        with self._lock:
+            inflight = self._inflight
+        return {
+            "fetched_rows": self.fetched_rows,
+            "commits": self.commits,
+            "inflight": inflight,
+            "residual_fetch_hit_rate": (
+                round(hits / lookups, 4) if lookups else 0.0
+            ),
+        }
+
+    def close(self):
+        self._jobs.put(None)
+        self._worker.join(timeout=5.0)

@@ -14,6 +14,18 @@ Backward is pure JAX (scatter-add of w·g, and g·x for the weights) via
 custom_vjp — gradient layout matches mp_ops (reference mp_ops.py:39-62).
 
 CPU/interpret fallback makes the same entry point usable in tests.
+
+The paged device-sampling lane (dataflow/device.py, layout="paged") adds
+two more entry points with the same impl discipline — `paged_gather`
+(ragged neighbor/weight gather through a fixed-size-page indirection,
+the Ragged-Paged-Attention access shape) and `paged_cdf_count` (the
+in-page step of the two-level quantized-CDF neighbor draw). Both carry a
+jitted jnp reference (`impl="xla"`) that is the `auto` fallback off-TPU
+and the A/B oracle; the Pallas forms are validated in interpret mode
+(tests/test_pallas.py) and exposed via `impl='pallas'`. The page-table
+binary search (`paged_page_search`) is scalar log-depth work that stays
+plain XLA in every impl — only the bandwidth-bound page reads are kernel
+territory.
 """
 
 from __future__ import annotations
@@ -176,3 +188,204 @@ def _bwd(impl, res, g):
 
 
 gather_weighted_sum.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# Paged ragged-indirection kernels (device-resident sampling lane)
+# ---------------------------------------------------------------------------
+
+# the flat page buffers are viewed [M, PAGE_LANES] so every DMA is a
+# one-row, lane-aligned copy — the exact shape Mosaic already accepts in
+# the gather_weighted_sum chunked path above. Logical page_size must
+# divide PAGE_LANES, so one page never straddles a lane row.
+PAGE_LANES = 128
+
+
+def _as_lane_rows(flat):
+    """Flat 4-byte-dtype buffer → [M, PAGE_LANES] lane-row view (padded)."""
+    flat = flat.reshape(-1)
+    pad = (-flat.shape[0]) % PAGE_LANES
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, PAGE_LANES)
+
+
+def _paged_gather_kernel(k, table_ref, fidx_ref, out_ref, scratch, sems):
+    # per (row i, draw j): DMA the lane row holding flat element
+    # fidx[i, j] into double-buffered scratch, then select its lane with
+    # an iota compare-sum (vector select — no dynamic lane extract).
+    def copies(i, buf):
+        for j in range(k):
+            yield pltpu.make_async_copy(
+                table_ref.at[fidx_ref[i, j] // PAGE_LANES],
+                scratch.at[buf, j],
+                sems.at[buf, j],
+            )
+
+    start = lambda i, buf: [cp.start() for cp in copies(i, buf)]  # noqa: E731
+    wait = lambda i, buf: [cp.wait() for cp in copies(i, buf)]  # noqa: E731
+
+    start(0, 0)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, PAGE_LANES), 1)
+    for i in range(TILE):
+        if i + 1 < TILE:
+            start(i + 1, (i + 1) % 2)
+        wait(i, i % 2)
+        vals = []
+        for j in range(k):
+            lane = fidx_ref[i, j] % PAGE_LANES
+            row = scratch[i % 2, j].reshape(1, PAGE_LANES)
+            vals.append(jnp.sum(jnp.where(lanes == lane, row, 0)))
+        out_ref[i, :] = jnp.stack(vals)
+
+
+def _paged_gather_pallas(table2d, fidx, interpret: bool):
+    n, k = fidx.shape
+    pad = (-n) % TILE
+    if pad:
+        fidx = jnp.pad(fidx, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_paged_gather_kernel, k),
+        grid=(fidx.shape[0] // TILE,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # pages stay in HBM
+            pl.BlockSpec(
+                (TILE, k), lambda i: (i, 0), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE, k), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((fidx.shape[0], k), table2d.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, k, PAGE_LANES), table2d.dtype),
+            pltpu.SemaphoreType.DMA((2, k)),
+        ],
+        interpret=interpret,
+    )(table2d, fidx.astype(jnp.int32))
+    return out[:n]
+
+
+def _paged_impl(impl: str) -> str:
+    # no on-chip profiling exists yet for the paged kernels, so `auto`
+    # routes everywhere to the jitted jnp reference (same stance as the
+    # measured _PALLAS_AUTO_MAX_F boundary above: auto only picks pallas
+    # where a win is measured). 'pallas'/'interpret' stay explicit.
+    if impl == "auto":
+        return "xla"
+    if impl not in ("xla", "pallas", "interpret"):
+        raise ValueError(f"unknown impl {impl!r}")
+    return impl
+
+
+def paged_gather(table2d, fidx, impl: str = "auto"):
+    """out[i, j] = flat(table2d)[fidx[i, j]] — ragged gather through the
+    paged indirection. `table2d` is a [M, 128] lane-row view of a flat
+    page buffer (`_as_lane_rows`); `fidx` int32 [W, k] flat element
+    indices (page*page_size + slot). 4-byte dtypes only."""
+    impl = _paged_impl(impl)
+    if impl == "xla":
+        flat = table2d.reshape(-1)
+        return flat[fidx]
+    return _paged_gather_pallas(table2d, fidx, interpret=(impl == "interpret"))
+
+
+def _paged_count_kernel(k, page_size, q_ref, page_ref, r_ref, out_ref,
+                        scratch, sems):
+    # per (row i, draw j): DMA the lane row holding page page_ref[i, j]
+    # (pages are page_size-aligned, page_size | PAGE_LANES, so a page
+    # never straddles rows), then count the page's lanes with q <= r.
+    def copies(i, buf):
+        for j in range(k):
+            yield pltpu.make_async_copy(
+                q_ref.at[(page_ref[i, j] * page_size) // PAGE_LANES],
+                scratch.at[buf, j],
+                sems.at[buf, j],
+            )
+
+    start = lambda i, buf: [cp.start() for cp in copies(i, buf)]  # noqa: E731
+    wait = lambda i, buf: [cp.wait() for cp in copies(i, buf)]  # noqa: E731
+
+    start(0, 0)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, PAGE_LANES), 1)
+    for i in range(TILE):
+        if i + 1 < TILE:
+            start(i + 1, (i + 1) % 2)
+        wait(i, i % 2)
+        vals = []
+        for j in range(k):
+            lane0 = (page_ref[i, j] * page_size) % PAGE_LANES
+            row = scratch[i % 2, j].reshape(1, PAGE_LANES)
+            sel = (lanes >= lane0) & (lanes < lane0 + page_size)
+            vals.append(
+                jnp.sum(jnp.where(sel & (row <= r_ref[i, j]), 1, 0))
+            )
+        out_ref[i, :] = jnp.stack(vals).astype(jnp.int32)
+
+
+def _paged_count_pallas(q2d, page, rbits, page_size: int, interpret: bool):
+    n, k = page.shape
+    pad = (-n) % TILE
+    if pad:
+        page = jnp.pad(page, ((0, pad), (0, 0)))
+        rbits = jnp.pad(rbits, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_paged_count_kernel, k, page_size),
+        grid=(page.shape[0] // TILE,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # quantized CDF in HBM
+            pl.BlockSpec(
+                (TILE, k), lambda i: (i, 0), memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                (TILE, k), lambda i: (i, 0), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE, k), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((page.shape[0], k), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((2, k, PAGE_LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, k)),
+        ],
+        interpret=interpret,
+    )(q2d, page.astype(jnp.int32), rbits)
+    return out[:n]
+
+
+def paged_cdf_count(q2d, page, rbits, page_size: int, impl: str = "auto"):
+    """In-page quantized-CDF inversion: out[i, j] = |{l < page_size :
+    flat(q2d)[page[i, j]*page_size + l] <= rbits[i, j]}| — the slot count
+    within the already-selected page. Padding lanes hold 0xFFFFFFFF so
+    they count only at rbits == MAX (callers clamp by degree)."""
+    impl = _paged_impl(impl)
+    if impl == "xla":
+        flat = q2d.reshape(-1)
+        base = page.astype(jnp.int32) * page_size
+        lanes = base[..., None] + jnp.arange(page_size, dtype=jnp.int32)
+        q = flat[lanes]  # [W, k, page_size]
+        return (q <= rbits[..., None]).sum(axis=-1).astype(jnp.int32)
+    return _paged_count_pallas(
+        q2d, page, rbits, page_size, interpret=(impl == "interpret")
+    )
+
+
+def paged_page_search(bound, pstart, npages, rbits, iters: int):
+    """Per-node upper-bound search over the flat page-boundary array:
+    returns [W, k] counts of the node's pages whose boundary (last valid
+    quantized-CDF value) is <= rbits — i.e. the pages the draw skips
+    entirely. Branchless binary search with a static iteration count
+    (`iters` >= bit_length(max pages per node) + 1); pure integer math,
+    so it is bit-identical across impls by construction and stays plain
+    XLA (log-depth scalar work — not kernel territory)."""
+    lo = jnp.broadcast_to(pstart[:, None].astype(jnp.int32), rbits.shape)
+    hi = lo + jnp.broadcast_to(npages[:, None].astype(jnp.int32), rbits.shape)
+    cap = bound.shape[0] - 1
+    for _ in range(max(int(iters), 1)):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        le = bound[jnp.minimum(mid, cap)] <= rbits
+        lo = jnp.where(active & le, mid + 1, lo)
+        hi = jnp.where(active & ~le, mid, hi)
+    return lo - pstart[:, None].astype(jnp.int32)

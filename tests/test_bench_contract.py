@@ -6,6 +6,42 @@ import json
 import os
 import subprocess
 import sys
+import time
+
+
+def test_probe_cache_round_trip(tmp_path, monkeypatch):
+    """The accelerator-probe cache (ISSUE 6 satellite): a cached negative
+    is honored only within its TTL, on the same boot, with the opt-out
+    respected — anything else must re-probe."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    monkeypatch.setattr(
+        bench, "PROBE_CACHE_PATH", str(tmp_path / "probe_cache.json")
+    )
+    monkeypatch.setattr(bench, "_PROBE_FAILURES", [{"attempt": 1,
+                                                    "timeout": True}])
+    bench._write_probe_cache(False)
+    rec = bench._read_probe_cache()
+    assert rec is not None and rec["ok"] is False and rec["failures"]
+    # TTL expiry invalidates
+    stale = json.load(open(bench.PROBE_CACHE_PATH))
+    stale["ts"] = time.time() - bench.PROBE_CACHE_TTL_S - 1
+    json.dump(stale, open(bench.PROBE_CACHE_PATH, "w"))
+    assert bench._read_probe_cache() is None
+    # a reboot (different boot key) invalidates
+    stale["ts"] = time.time()
+    stale["boot_key"] = "some-other-boot"
+    json.dump(stale, open(bench.PROBE_CACHE_PATH, "w"))
+    assert bench._read_probe_cache() is None
+    # EULER_BENCH_PROBE_CACHE=0 opts out of reads AND writes
+    bench._write_probe_cache(False)
+    monkeypatch.setenv("EULER_BENCH_PROBE_CACHE", "0")
+    assert bench._read_probe_cache() is None
+    os.unlink(bench.PROBE_CACHE_PATH)
+    bench._write_probe_cache(False)
+    assert not os.path.exists(bench.PROBE_CACHE_PATH)
 
 
 def test_bench_smoke_emits_final_json_line():
@@ -33,6 +69,16 @@ def test_bench_smoke_emits_final_json_line():
     assert row["unit"] == "edges/s"
     assert "vs_baseline" in row and "backend" in row
     assert row["device_flow"] is True  # smoke covers the production default
+    # the paged device-lane A/B (ISSUE 6) must not silently vanish: the
+    # skewed weighted graph records paged vs dense sampling throughput,
+    # the standing bit-identity oracle, and the interpret-mode kernel
+    # validation, all on the artifact
+    assert row["paged"] is True, row
+    assert row["paged_bit_identical"] is True
+    assert row["paged_interpret_ok"] is True
+    assert row["paged_sample_edges_per_sec"] > 0
+    assert row["dense_sample_edges_per_sec"] > 0
+    assert row["paged_over_dense"] > 0
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline
@@ -94,3 +140,12 @@ def test_bench_smoke_remote_lane_cache_fields():
         "cache_warm_over_uncached",
     ):
         assert row[k] > 0, (k, row)
+    # the remote paged device sub-lane (ISSUE 6): the adjacency staged
+    # over the wire, per-step sampling fully on device, and residual row
+    # fetches served through the client ReadCache — these keys gone means
+    # the lane silently vanished from the artifact
+    assert row["device_flow"] is True, row
+    assert row["paged"] is True, row
+    assert row["paged_device_edges_per_sec"] > 0
+    assert row["residual_fetch_hit_rate"] > 0, row
+    assert row["residual_rows_refetched"] > 0

@@ -87,6 +87,7 @@ def test_remote_shard_client_surface_stays_inside_its_table():
     probes = [
         lambda: shard.lookup([1]),
         lambda: shard.node_type([1]),
+        lambda: shard.ids_by_rows([0]),
         lambda: shard.sample_node(1),
         lambda: shard.sample_edge(1),
         lambda: shard.sample_neighbor([1]),
