@@ -848,6 +848,254 @@ def _emit_recovery(value: float, extra: dict) -> None:
     )
 
 
+def run_fleet(platform: str) -> tuple[float, dict]:
+    """The serving-fleet lane (ISSUE 7): 4 replicated ModelServers behind
+    a consistent-hash ServingRouter, hammered by concurrent closed-loop
+    clients. Reports aggregate fleet req/s as the headline, plus:
+
+      fleet_scaling_4x — aggregate req/s at 4 replicas over 1 replica.
+        Replicas are in-process (device steps release the GIL), so the
+        ratio reflects real parallel headroom: ~4x needs >= 4 cores, and
+        `fleet_cores` records what this host could physically show.
+      hedged_p99_ms / unhedged_p99_ms — p99 with one seeded straggler
+        replica (chaos `server delay` on its predict dispatch) with and
+        without budget-capped hedging; hedge telemetry proves the hedges
+        stayed inside the token bucket.
+      reload_parity — zero-downtime hot reload of the same checkpoint on
+        one replica, canary rows bit-identical pre/post swap through the
+        live batcher.
+    """
+    import tempfile
+    import threading
+
+    from euler_tpu.dataflow import FullNeighborDataFlow
+    from euler_tpu.datasets.synthetic import random_graph
+    from euler_tpu.distributed import Fault, FaultPlan, chaos
+    from euler_tpu.estimator import (
+        Estimator,
+        EstimatorConfig,
+        id_batches,
+        node_batches,
+    )
+    from euler_tpu.models import GraphSAGESupervised
+    from euler_tpu.serving import (
+        InferenceRuntime,
+        ModelServer,
+        ServingClient,
+        ServingRouter,
+    )
+
+    replicas = 4
+    if SMOKE:
+        num_nodes, feat_dim, dims = 2000, 16, [16, 16]
+        bucket, ids_per_req = 16, 16
+        clients, reqs = 8, 16
+        straggler_reqs = 10
+    else:
+        num_nodes, feat_dim, dims = 8000, 32, [32, 32]
+        bucket, ids_per_req = 32, 32
+        clients, reqs = 12, 30
+        straggler_reqs = 16
+    straggler_delay_s = 0.25
+    graph = random_graph(
+        num_nodes=num_nodes, out_degree=8, feat_dim=feat_dim, seed=11
+    )
+
+    def mkflow():
+        # deterministic per root: the precondition for the hedged ==
+        # unhedged == offline-infer bit-parity claim
+        return FullNeighborDataFlow(
+            graph, ["feat"], num_hops=2, max_degree=6, label_feature="label"
+        )
+
+    flow = mkflow()
+    model = GraphSAGESupervised(dims=dims, label_dim=2)
+    cfg = EstimatorConfig(
+        model_dir=tempfile.mkdtemp(prefix="etpu_fleet_bench_"),
+        log_steps=10**9,
+    )
+    est = Estimator(
+        model,
+        node_batches(graph, flow, bucket, rng=np.random.default_rng(13)),
+        cfg,
+    )
+    est.train(total_steps=1, log=False)  # a real (if brief) checkpoint
+
+    servers = []
+    for i in range(replicas):
+        runtime = InferenceRuntime(model, mkflow(), cfg, buckets=(bucket,))
+        runtime.warmup()
+        servers.append(ModelServer(runtime, max_wait_us=2000, shard=i).start())
+    addrs = [(s.host, s.port) for s in servers]
+
+    def hammer(client, n_clients, n_reqs, seed0):
+        lats = [[] for _ in range(n_clients)]
+        errors: list = []
+
+        def worker(k):
+            rng = np.random.default_rng(
+                np.random.SeedSequence([17, seed0, k])
+            )
+            try:
+                for _ in range(n_reqs):
+                    ids = rng.integers(
+                        1, num_nodes + 1, size=ids_per_req
+                    ).astype(np.uint64)
+                    t0 = time.perf_counter()
+                    client.predict(ids)
+                    lats[k].append((time.perf_counter() - t0) * 1e3)
+            except Exception as e:  # lane must report, not die
+                errors.append(repr(e)[:200])
+
+        threads = [
+            threading.Thread(target=worker, args=(k,))
+            for k in range(n_clients)
+        ]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t_start
+        lat = np.asarray([x for chunk in lats for x in chunk])
+        if errors or len(lat) == 0:
+            raise RuntimeError(f"fleet lane failed: {errors[:3]}")
+        return len(lat) / elapsed, lat
+
+    try:
+        # bit-parity anchor: routed predictions == offline Estimator.infer
+        probe_ids = np.arange(1, min(num_nodes, 64) + 1, dtype=np.uint64)
+        batches, chunks = id_batches(flow, probe_ids, bucket)
+        _, direct = est.infer(batches, chunks)
+        parity_client = ServingClient(addrs, routing="consistent_hash")
+        routed = parity_client.predict(probe_ids)
+        bit_parity = bool(np.array_equal(routed, direct))
+        parity_client.close()
+
+        # warm each replica's wire + flow path once before timing
+        for addr in addrs:
+            w = ServingClient(addr)
+            w.predict(np.arange(1, ids_per_req + 1, dtype=np.uint64))
+            w.close()
+
+        # ---- scaling: 1 replica vs 4 replicas, hedging off so the
+        # ratio measures routing spread, not duplicate hedge load
+        solo_client = ServingClient(
+            [addrs[0]],
+            routing=ServingRouter([addrs[0]], hedge=False),
+        )
+        solo_rps, _ = hammer(solo_client, clients, reqs, seed0=1)
+        solo_client.close()
+        fleet_client = ServingClient(
+            addrs,
+            routing=ServingRouter(
+                addrs, policy="consistent_hash", hedge=False
+            ),
+        )
+        fleet_rps, fleet_lat = hammer(fleet_client, clients, reqs, seed0=2)
+        fleet_client.close()
+
+        # ---- hedging under one seeded straggler replica: the chaos
+        # `server delay` fault stalls every predict dispatched on the
+        # last replica; consistent-hash routing keeps sending ~1/4 of
+        # requests into it, so the unhedged p99 IS the straggler
+        chaos.install(FaultPlan([
+            Fault(site="server", kind="delay", op="predict",
+                  shard=replicas - 1, delay_s=straggler_delay_s),
+        ], seed=23))
+        try:
+            unhedged = ServingRouter(
+                addrs, policy="consistent_hash", hedge=False
+            )
+            unhedged_client = ServingClient(addrs, routing=unhedged)
+            _, unhedged_lat = hammer(
+                unhedged_client, clients, straggler_reqs, seed0=3
+            )
+            unhedged_client.close()
+            # pinned hedge delay (the EULER_TPU_HEDGE_MS shape): with a
+            # SEEDED straggler owning ~1/4 of the traffic, the p95 of
+            # observed latencies converges onto the straggler itself, so
+            # the adaptive delay is the wrong tool for this measurement
+            hedged = ServingRouter(
+                addrs, policy="consistent_hash", hedge=True,
+                hedge_ms=straggler_delay_s * 1e3 * 0.25,
+            )
+            hedge_cap = hedged._hedge_budget.cap
+            hedged_client = ServingClient(addrs, routing=hedged)
+            _, hedged_lat = hammer(
+                hedged_client, clients, straggler_reqs, seed0=4
+            )
+            hstats = hedged.stats()
+            hedged_client.close()
+        finally:
+            chaos.uninstall()
+
+        # within-budget proof: every hedge spent a token the bucket
+        # could cover (cap + refill-per-success), and none were denied
+        # by a dry bucket mid-measurement
+        hedged_within_budget = bool(
+            hstats["hedges"]
+            <= hedge_cap + 0.5 * max(hstats["requests"], 1)
+        )
+
+        # ---- zero-downtime hot reload: same checkpoint back in, canary
+        # rows through the live batcher must be bit-identical pre/post
+        reload_client = ServingClient(addrs[0])
+        report = reload_client.reload(
+            canary_ids=probe_ids[: min(len(probe_ids), bucket)]
+        )
+        reload_client.close()
+        reload_parity = bool(
+            all(
+                r.get("canary_parity") is True
+                for r in report.values()
+            )
+        )
+
+        unhedged_p99 = float(np.percentile(unhedged_lat, 99))
+        hedged_p99 = float(np.percentile(hedged_lat, 99))
+        extra = {
+            "backend": platform + ("-fallback" if CPU_FALLBACK else ""),
+            "replicas": replicas,
+            "fleet_cores": os.cpu_count() or 1,
+            "routing": "consistent_hash",
+            "fleet_req_per_sec": round(fleet_rps, 1),
+            "solo_req_per_sec": round(solo_rps, 1),
+            "fleet_scaling_4x": round(fleet_rps / max(solo_rps, 1e-9), 3),
+            "fleet_p50_ms": round(float(np.percentile(fleet_lat, 50)), 2),
+            "fleet_p99_ms": round(float(np.percentile(fleet_lat, 99)), 2),
+            "straggler_delay_ms": round(straggler_delay_s * 1e3, 1),
+            "unhedged_p99_ms": round(unhedged_p99, 2),
+            "hedged_p99_ms": round(hedged_p99, 2),
+            "hedge_p99_cut": round(
+                unhedged_p99 / max(hedged_p99, 1e-9), 3
+            ),
+            "hedges_issued": int(hstats["hedges"]),
+            "hedges_won": int(hstats["hedges_won"]),
+            "hedges_denied": int(hstats["hedges_denied"]),
+            "hedge_budget_cap": hedge_cap,
+            "hedged_within_budget": hedged_within_budget,
+            "reload_parity": reload_parity,
+            "fleet_bit_parity": bit_parity,
+            "clients": clients,
+            "ids_per_request": ids_per_req,
+            "bucket": bucket,
+        }
+        return fleet_rps, extra
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def _emit_fleet(value: float, extra: dict) -> None:
+    emit(
+        value, extra,
+        metric="gnn_fleet_requests_per_sec",
+        unit="req/s",
+        baseline=None,
+    )
+
+
 _DATASET_GEN_V = 2  # bump when the synthetic generator changes, so cached
 # /tmp datasets from older generator code are never silently reused
 
@@ -1263,6 +1511,20 @@ def main():
     remote_enabled = os.environ.get("EULER_BENCH_REMOTE", "1") != "0"
     serving_enabled = os.environ.get("EULER_BENCH_SERVING", "1") != "0"
     recovery_enabled = os.environ.get("EULER_BENCH_RECOVERY", "1") != "0"
+    fleet_enabled = os.environ.get("EULER_BENCH_FLEET", "1") != "0"
+
+    # ---- fleet-only mode: just the serving-fleet lane (its own JSON
+    # contract line), for the fleet gate in tests/test_bench_contract.py
+    if "--fleet-only" in sys.argv:
+        try:
+            f_value, f_extra = run_fleet(platform)
+            _emit_fleet(f_value, f_extra)
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            _emit_fleet(0.0, {"backend": platform, "error": repr(e)[:300]})
+        return
 
     # ---- LOCAL leg first: the headline artifact is emitted before the
     # remote leg can spend a second of the driver's timeout (VERDICT r3 #1).
@@ -1321,11 +1583,33 @@ def main():
                 0.0, {"backend": platform, "error": repr(e)[:300]}
             )
 
+    # ---- FLEET lane: 4 in-process replicas behind the router, seeded
+    # straggler + hedging, hot reload — seconds of wall clock, emitted
+    # immediately like the lanes above.
+    if fleet_enabled and "--remote-only" not in sys.argv:
+        try:
+            f_value, f_extra = run_fleet(platform)
+            _emit_fleet(f_value, f_extra)
+            extra = dict(
+                extra,
+                fleet_req_per_sec=round(float(f_value), 1),
+                fleet_scaling_4x=f_extra["fleet_scaling_4x"],
+                hedged_p99_ms=f_extra["hedged_p99_ms"],
+                reload_parity=f_extra["reload_parity"],
+            )
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            _emit_fleet(0.0, {"backend": platform, "error": repr(e)[:300]})
+
     if not remote_enabled:
         if "--remote-only" in sys.argv:
             # never exit silently: the contract is at least one JSON line
             emit(0.0, {"error": "--remote-only with EULER_BENCH_REMOTE=0"})
-        elif (serving_enabled or recovery_enabled) and value is not None:
+        elif (
+            serving_enabled or recovery_enabled or fleet_enabled
+        ) and value is not None:
             # the serving lane printed after the headline; re-emit the
             # headline (serving summary attached) so BOTH first-line and
             # last-line parsers still read the local number

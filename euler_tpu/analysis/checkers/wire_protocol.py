@@ -69,7 +69,10 @@ DEFAULT_DOMAINS = (
     ),
     WireDomain(
         name="serving",
-        clients=("euler_tpu/serving/client.py",),
+        clients=(
+            "euler_tpu/serving/client.py",
+            "euler_tpu/serving/router.py",
+        ),
         servers=("euler_tpu/serving/server.py",),
     ),
 )

@@ -111,16 +111,26 @@ class RetryBudget:
         self.refill = float(refill)
         self._lock = threading.Lock()
         self._tokens = float(cap)
+        self._denied = 0
 
     @property
     def tokens(self) -> float:
         return self._tokens
+
+    @property
+    def denied(self) -> int:
+        """Spends refused by a dry bucket — the storms that did NOT
+        happen (retry storms for the RPC retry loop, hedge storms for
+        the serving router); dashboards watch this to see a budget
+        actively protecting a degraded fleet."""
+        return self._denied
 
     def try_spend(self) -> bool:
         with self._lock:
             if self._tokens >= 1.0:
                 self._tokens -= 1.0
                 return True
+            self._denied += 1
             return False
 
     def on_success(self) -> None:

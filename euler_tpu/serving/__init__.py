@@ -1,20 +1,33 @@
 """Online inference serving: micro-batched, admission-controlled GNN
-model server.
+model serving — single replica or a routed fleet.
 
-    InferenceRuntime — checkpoint + model + dataflow, compiled per bucket
+    InferenceRuntime — checkpoint + model + dataflow, compiled per bucket;
+                       swap() hot-reloads a checkpoint with zero downtime
     MicroBatcher     — coalesce concurrent requests into one device step
-    ModelServer      — predict/server_stats wire verbs (pooled-TCP stack)
-    ServingClient    — retrying client with typed fast-fail errors
+    TenantQuota      — per-tenant admission layered over the bounded queue
+    ModelServer      — predict/server_stats/reload wire verbs (pooled TCP)
+    ServingClient    — retrying client with typed fast-fail errors,
+                       fleet_stats()/ping_all() operator surface
+    ServingRouter    — replicated routing (consistent-hash / least-loaded),
+                       budget-capped hedging, transport failover
 
 See SCALE.md "Online serving" for the batching policy and overload
-semantics, and `python -m euler_tpu.tools.serve` for the CLI.
+semantics, SCALE.md "Serving fleet" for the fleet topology and knobs,
+and `python -m euler_tpu.tools.serve` for the CLI.
 """
 
 from euler_tpu.serving.batcher import (  # noqa: F401
     DeadlineExceededError,
     MicroBatcher,
     OverloadError,
+    TenantQuota,
 )
 from euler_tpu.serving.client import ServingClient  # noqa: F401
+from euler_tpu.serving.router import (  # noqa: F401
+    ConsistentHashPolicy,
+    LeastLoadedPolicy,
+    RoutingPolicy,
+    ServingRouter,
+)
 from euler_tpu.serving.runtime import InferenceRuntime  # noqa: F401
 from euler_tpu.serving.server import ModelServer  # noqa: F401

@@ -13,6 +13,13 @@ Bucketing is the TPU-serving move (Ragged Paged Attention, arXiv:
 2604.15464): concurrent requests coalesce into a small fixed menu of
 padded shapes against persistent compiled programs, instead of paying a
 retrace/recompile per request size.
+
+Hot reload: the checkpoint + jitted program set live in one immutable
+`_Engine`; `swap()` builds and warms a NEW engine off the dispatch path,
+then publishes it with a single reference assignment. Every predict()
+grabs the engine reference once at entry, so an in-flight request —
+including a chunked oversized one — runs start to finish on one
+checkpoint and a swap can never drop, error, or mix it.
 """
 
 from __future__ import annotations
@@ -24,6 +31,19 @@ import numpy as np
 DEFAULT_BUCKETS = (8, 32, 128)
 
 
+class _Engine:
+    """One checkpoint's serving state: estimator + its embed program.
+
+    Immutable after construction — swap() replaces the whole object, so
+    readers never observe a half-updated (est, embed) pair."""
+
+    __slots__ = ("est", "embed")
+
+    def __init__(self, est, embed):
+        self.est = est
+        self.embed = embed
+
+
 class InferenceRuntime:
     """One model + checkpoint + dataflow, compiled for serving.
 
@@ -33,7 +53,8 @@ class InferenceRuntime:
     correctly — their predictions just aren't replayable.
 
     Not thread-safe by design: `predict` is called from ONE dispatcher
-    thread (the MicroBatcher's); direct callers must serialize.
+    thread (the MicroBatcher's); direct callers must serialize. `swap`
+    IS safe to call from any other thread while the dispatcher runs.
     """
 
     def __init__(
@@ -49,36 +70,53 @@ class InferenceRuntime:
         """cfg: EstimatorConfig (model_dir locates the checkpoint) or a
         model_dir string. params: pre-loaded parameter pytree — skips the
         checkpoint restore (in-process selftests, tests)."""
-        from euler_tpu.estimator import Estimator, EstimatorConfig
+        from euler_tpu.estimator import EstimatorConfig
 
         if isinstance(cfg, str):
             cfg = EstimatorConfig(model_dir=cfg)
+        self.model = model
         self.flow = flow
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"bad buckets {buckets!r}")
-        self._est = Estimator(
-            model,
-            self._probe_batch_fn(),
-            cfg,
-            mesh=mesh,
-            feature_cache=feature_cache,
-            init_params=params,
-        )
-        if params is None:
-            if not self._est.restore():
-                raise FileNotFoundError(
-                    "no checkpoint under "
-                    f"{self._est.cfg.model_dir!r} — train + save first, or "
-                    "pass params="
-                )
-        else:
-            self._est._ensure_init()
-        self._embed = self._est.embed_program()
+        self._mesh = mesh
+        self._feature_cache = feature_cache
+        # serializes swap() callers and guards the _cfg/_engine publishes;
+        # the predict path never takes it (it reads one reference)
+        self._swap_lock = threading.Lock()
+        with self._swap_lock:
+            self._cfg = cfg
+            self._engine = self._build_engine(cfg, params)
         # telemetry for the micro-batching proof: executed device batches
         # must undercut request count under concurrency
         self.device_batches = 0
+        self.reloads = 0
         self.lock = threading.Lock()  # guards direct multi-caller use
+
+    def _build_engine(self, cfg, params) -> _Engine:
+        """Estimator + compiled embed program for one checkpoint — built
+        entirely off the dispatch path (nothing reads it until the
+        engine reference is published)."""
+        from euler_tpu.estimator import Estimator
+
+        est = Estimator(
+            self.model,
+            self._probe_batch_fn(),
+            cfg,
+            mesh=self._mesh,
+            feature_cache=self._feature_cache,
+            init_params=params,
+        )
+        if params is None:
+            if not est.restore():
+                raise FileNotFoundError(
+                    "no checkpoint under "
+                    f"{est.cfg.model_dir!r} — train + save first, or "
+                    "pass params="
+                )
+        else:
+            est._ensure_init()
+        return _Engine(est, est.embed_program())
 
     def _probe_batch_fn(self):
         """Init-shape probe batch for Estimator._ensure_init: any roots of
@@ -100,7 +138,17 @@ class InferenceRuntime:
 
     @property
     def params(self):
-        return self._est.params
+        return self._engine.est.params
+
+    @property
+    def _est(self):
+        """The live engine's Estimator (back-compat accessor)."""
+        return self._engine.est
+
+    @property
+    def _embed(self):
+        """The live engine's jitted embed program (back-compat accessor)."""
+        return self._engine.embed
 
     def bucket_for(self, n: int) -> int:
         """Smallest bucket holding n roots (n > max bucket → max bucket;
@@ -113,28 +161,65 @@ class InferenceRuntime:
     def warmup(self) -> None:
         """Trace + compile every bucket's program up front, so the first
         real request never pays a compile."""
+        eng = self._engine
         for b in self.buckets:
-            self._predict_bucket(np.ones(b, np.uint64), b)
+            self._predict_bucket(np.ones(b, np.uint64), b, eng)
+
+    def swap(self, cfg=None, params=None, warm: bool = True) -> dict:
+        """Zero-downtime checkpoint hot reload.
+
+        Builds a NEW engine from `cfg` (an EstimatorConfig / model_dir
+        string; default: re-restore the current model_dir, picking up a
+        newer checkpoint written in place) or from a `params` pytree,
+        warms every bucket's jitted program against it, then publishes it
+        with one reference assignment. The dispatch path is never paused:
+        requests in flight — even mid-chunk — finish on the engine they
+        started on, and the first request after the publish runs the new
+        checkpoint on already-compiled programs."""
+        from euler_tpu.estimator import EstimatorConfig
+
+        if isinstance(cfg, str):
+            cfg = EstimatorConfig(model_dir=cfg)
+        with self._swap_lock:
+            new_cfg = cfg if cfg is not None else self._cfg
+            eng = self._build_engine(new_cfg, params)
+            warmed = []
+            if warm:
+                for b in self.buckets:
+                    self._predict_bucket(np.ones(b, np.uint64), b, eng)
+                    warmed.append(b)
+            self._cfg = new_cfg
+            self._engine = eng  # atomic publish: the swap itself
+            self.reloads += 1
+            return {
+                "reloaded": True,
+                "reloads": self.reloads,
+                "warmed_buckets": warmed,
+                "model_dir": getattr(new_cfg, "model_dir", None),
+            }
 
     def predict(self, node_ids) -> np.ndarray:
         """Embeddings for `node_ids` ([n, D] float); pads each chunk to a
         bucket so only pre-compiled shapes ever execute."""
+        eng = self._engine  # one checkpoint per request, even chunked
         ids = np.asarray(node_ids, dtype=np.uint64).reshape(-1)
         if len(ids) == 0:
             raise ValueError("empty id list")
         top = self.buckets[-1]
         if len(ids) <= top:
-            return self._predict_bucket(ids, self.bucket_for(len(ids)))
+            return self._predict_bucket(ids, self.bucket_for(len(ids)), eng)
         return np.concatenate(
             [
-                self._predict_bucket(ids[lo : lo + top], top)
+                self._predict_bucket(ids[lo : lo + top], top, eng)
                 for lo in range(0, len(ids), top)
             ]
         )
 
-    def _predict_bucket(self, ids: np.ndarray, bucket: int) -> np.ndarray:
+    def _predict_bucket(
+        self, ids: np.ndarray, bucket: int, eng: _Engine
+    ) -> np.ndarray:
         batch, n = self.flow.query_padded(ids, bucket)
-        batch = self._est._put((batch,))
-        emb = np.asarray(self._embed(self.params, batch[0]))
+        batch = eng.est._put((batch,))
+        emb = np.asarray(eng.embed(eng.est.params, batch[0]))
         self.device_batches += 1
         return emb[:n]

@@ -1,5 +1,5 @@
-"""ModelServer — `predict`/`server_stats` wire verbs over the pooled-TCP
-stack.
+"""ModelServer — `predict`/`server_stats`/`reload` wire verbs over the
+pooled-TCP stack.
 
 Reuses the graph service's `_PoolServer` (distributed/service.py): a
 selector thread parks idle connections, a bounded worker pool runs the
@@ -9,9 +9,11 @@ step — the pool's concurrency IS the batching window. No coordinator
 threads (a model server never fans out to peers).
 
 Verbs:
-  predict      [ids u64[n], deadline_ms float|None] → [emb f32[n, D]]
+  predict      [ids u64[n], deadline_ms float|None, tenant str|None]
+                                                    → [emb f32[n, D]]
   server_stats []                                   → [json]
   ping         []                                   → [0]
+  reload       [model_dir str|None, canary u64|None]→ [json report]
 
 Overload and deadline rejections ride the existing "err" status frame
 with a typed prefix ("OverloadError: ...", "DeadlineExceeded: ...") so
@@ -19,6 +21,13 @@ clients raise the typed exception instead of a generic RpcError — and
 never failover-retry either (they are deterministic server decisions,
 not transport faults). Requests without an explicit predict deadline
 inherit the wire-envelope budget every verb now carries.
+
+`reload` is the zero-downtime hot-reload verb: it runs in ONE pool
+worker while every other worker keeps serving — the new checkpoint's
+programs build and warm off the dispatch path, the engine publish is a
+single reference swap, and when the caller ships canary ids the pre/post
+rows go through the LIVE batcher (the exact served path) so the returned
+`canary_parity` is a bit-level proof, not a side computation.
 """
 
 from __future__ import annotations
@@ -26,8 +35,10 @@ from __future__ import annotations
 import json
 import time
 
+import numpy as np
+
 from euler_tpu.distributed.service import _PoolServer
-from euler_tpu.serving.batcher import MicroBatcher
+from euler_tpu.serving.batcher import MicroBatcher, TenantQuota
 
 
 class ModelServer:
@@ -44,15 +55,19 @@ class ModelServer:
         workers: int | None = None,
         registry=None,
         shard: int = 0,
+        tenant_quota: TenantQuota | None = None,
     ):
         self.runtime = runtime
         if max_batch is None:
             max_batch = max(getattr(runtime, "buckets", (128,)))
+        if tenant_quota is None:
+            tenant_quota = TenantQuota.from_env()
         self.batcher = MicroBatcher(
             runtime,
             max_batch=max_batch,
             max_wait_us=max_wait_us,
             max_queue=max_queue,
+            tenant_quota=tenant_quota,
         )
         self.may_coordinate = False  # _PoolServer: no coordinator threads
         if workers is None:
@@ -96,7 +111,7 @@ class ModelServer:
     # Load-bearing: dispatch() gates on it, graftlint's wire-protocol
     # checker diffs it against the `op ==` arms and ServingClient's
     # WIRE_VERBS, and tests/test_wire_parity.py asserts parity at runtime.
-    HANDLED_VERBS = frozenset({"predict", "server_stats", "ping"})
+    HANDLED_VERBS = frozenset({"predict", "server_stats", "ping", "reload"})
 
     def is_coordinator(self, op: str) -> bool:
         return False
@@ -106,6 +121,7 @@ class ModelServer:
             raise ValueError(f"unknown op {op!r}")
         if op == "predict":
             deadline_ms = a[1] if len(a) > 1 else None
+            tenant = a[2] if len(a) > 2 else None
             deadline = (
                 time.monotonic() + float(deadline_ms) / 1e3
                 if deadline_ms
@@ -120,17 +136,43 @@ class ModelServer:
             # admission control raises OverloadError HERE (fast-fail);
             # otherwise the worker blocks on the future while the batcher
             # coalesces it with the other in-flight workers' requests
-            return [self.batcher.predict(a[0], deadline)]
+            return [self.batcher.predict(a[0], deadline, tenant=tenant)]
         if op == "server_stats":
             stats = self.batcher.stats()
             stats.update(
                 device_batches=getattr(self.runtime, "device_batches", None),
                 buckets=list(getattr(self.runtime, "buckets", ())),
+                reloads=getattr(self.runtime, "reloads", 0),
                 uptime_s=round(time.monotonic() - self._started, 3),
             )
             return [json.dumps(stats)]
         if op == "ping":
             return [0]
+        if op == "reload":
+            return [json.dumps(self._reload(a))]
         raise RuntimeError(
             f"op {op!r} is in HANDLED_VERBS but has no dispatch arm"
         )
+
+    def _reload(self, a: list) -> dict:
+        """Hot-swap the runtime's checkpoint with a canary bit-parity
+        proof measured through the live batcher (the served path)."""
+        from euler_tpu.distributed.service import current_deadline
+
+        model_dir = a[0] if a else None
+        canary = a[1] if len(a) > 1 else None
+        deadline = current_deadline()
+        pre = None
+        if canary is not None and len(canary):
+            canary = np.asarray(canary, np.uint64).reshape(-1)
+            pre = self.batcher.predict(canary, deadline)
+        report = self.runtime.swap(cfg=model_dir if model_dir else None)
+        if pre is not None:
+            post = self.batcher.predict(canary, deadline)
+            report["canary_n"] = int(len(canary))
+            report["canary_parity"] = bool(
+                pre.shape == post.shape
+                and pre.dtype == post.dtype
+                and np.array_equal(pre, post)
+            )
+        return report

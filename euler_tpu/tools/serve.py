@@ -1,31 +1,42 @@
 """Model-serving CLI (`euler.start` parity for the online path).
 
-Boots a ModelServer over a graph dir + Orbax checkpoint:
+Boots one ModelServer — or a replicated fleet — over a graph dir + Orbax
+checkpoint:
 
     python -m euler_tpu.tools.serve --data DIR --model-dir CKPT \
-        --dims 128,128 --label-dim 2 --port 9200
+        --dims 128,128 --label-dim 2 --port 9200 --replicas 4
 
 Graph queries run in-process against the local shard files (native
 engine when available); model config must match the checkpoint. With
-`--registry REG` the server heartbeats into the same registry the graph
+`--registry REG` the servers heartbeat into the same registry the graph
 services use, so clients discover model replicas the way they discover
-shards.
+shards. `--replicas N` boots N servers (consecutive ports when --port is
+pinned, ephemeral otherwise), each with its own runtime + batcher —
+clients front them with a ServingRouter (`ServingClient(addrs,
+routing="consistent_hash")`). `--hedge MS` is the fleet's recommended
+hedge delay, printed with the topology (and exercised by the fleet
+selftest). `--reload` watches the checkpoint path and hot-swaps every
+replica — zero downtime — when a new checkpoint lands.
 
 `--selftest` is the smoke mode: builds a tiny synthetic graph + trains a
 2-step checkpoint in a temp dir, boots server + client in-process,
 asserts served predictions match direct inference bit-for-bit, prints a
-JSON summary, and exits 0 — wired into the fast test gate.
+JSON summary, and exits 0 — wired into the fast test gate. With
+`--replicas N` the selftest boots the whole fleet and additionally
+proves routed parity, per-replica fleet stats, and hot-reload canary
+parity.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 
 
-def build_runtime(args):
+def build_runtime(args, graph=None):
     import numpy as np
 
     from euler_tpu.dataflow import FullNeighborDataFlow, SageDataFlow
@@ -34,9 +45,12 @@ def build_runtime(args):
     from euler_tpu.models import GraphSAGESupervised
     from euler_tpu.serving import InferenceRuntime
 
-    graph = Graph.load(args.data, native=None if args.native else False)
+    if graph is None:
+        graph = Graph.load(args.data, native=None if args.native else False)
     features = args.features.split(",") if args.features else []
     dims = [int(x) for x in args.dims.split(",")]
+    # each replica gets its OWN flow over the shared graph: a flow is
+    # only ever queried from its replica's single batcher thread
     if args.full_neighbor:
         flow = FullNeighborDataFlow(
             graph,
@@ -64,29 +78,76 @@ def build_runtime(args):
     )
 
 
-def serve_model(runtime, args):
+def serve_fleet(args) -> list:
+    """Boot args.replicas ModelServers over one shared graph."""
     from euler_tpu.distributed.rendezvous import make_registry
+    from euler_tpu.graph import Graph
     from euler_tpu.serving import ModelServer
 
     registry = make_registry(args.registry) if args.registry else None
-    server = ModelServer(
-        runtime,
-        host=args.host,
-        port=args.port,
-        max_batch=args.max_batch,
-        max_wait_us=args.max_wait_us,
-        max_queue=args.max_queue,
-        registry=registry,
-        shard=args.replica,
-    )
-    runtime.warmup()
-    return server.start()
+    graph = Graph.load(args.data, native=None if args.native else False)
+    servers = []
+    for i in range(args.replicas):
+        runtime = build_runtime(args, graph=graph)
+        port = args.port + i if args.port else 0
+        server = ModelServer(
+            runtime,
+            host=args.host,
+            port=port,
+            max_batch=args.max_batch,
+            max_wait_us=args.max_wait_us,
+            max_queue=args.max_queue,
+            registry=registry,
+            shard=args.replica + i,
+        )
+        runtime.warmup()
+        servers.append(server.start())
+    return servers
 
 
-def selftest() -> int:
-    """In-process boot: synthetic graph → 2-step checkpoint → server +
+def _ckpt_mtime(model_dir: str) -> float:
+    path = os.path.join(os.path.abspath(model_dir), "ckpt")
+    try:
+        # the checkpoint dir's newest entry: orbax writes a fresh tree on
+        # every save, so any rewrite moves this forward
+        return max(
+            os.path.getmtime(os.path.join(path, e))
+            for e in os.listdir(path)
+        )
+    except (OSError, ValueError):
+        return 0.0
+
+
+def watch_reload(servers, model_dir: str, stop_event, poll_s: float):
+    """Hot-swap every replica whenever a new checkpoint lands under
+    model_dir — the serving fleet never restarts for a deploy."""
+    last = _ckpt_mtime(model_dir)
+    while not stop_event.wait(poll_s):
+        now = _ckpt_mtime(model_dir)
+        if now <= last:
+            continue
+        last = now
+        for server in servers:
+            try:
+                report = server.runtime.swap()
+                print(
+                    f"hot-reloaded {server.host}:{server.port}: "
+                    f"{json.dumps(report)}",
+                    flush=True,
+                )
+            except Exception as e:  # keep serving the old checkpoint
+                print(
+                    f"hot-reload FAILED on {server.host}:{server.port}: "
+                    f"{e!r} (replica keeps its current checkpoint)",
+                    flush=True,
+                )
+
+
+def selftest(replicas: int = 1, hedge_ms: float | None = None) -> int:
+    """In-process boot: synthetic graph → 2-step checkpoint → fleet +
     concurrent clients → bit-parity vs direct inference. Exit 0 = the
-    serving path works end to end on this host."""
+    serving path works end to end on this host. replicas > 1 also proves
+    routed parity, fleet stats, and hot-reload canary parity."""
     import tempfile
 
     import numpy as np
@@ -128,9 +189,13 @@ def selftest() -> int:
         for d in (1, 2, 3)
     ]
     graph = Graph.from_json({"nodes": nodes, "edges": edges})
-    flow = FullNeighborDataFlow(
-        graph, ["feat"], num_hops=2, max_degree=4, label_feature="label"
-    )
+
+    def mkflow():
+        return FullNeighborDataFlow(
+            graph, ["feat"], num_hops=2, max_degree=4, label_feature="label"
+        )
+
+    flow = mkflow()
     model = GraphSAGESupervised(dims=[8, 8], label_dim=2)
     cfg = EstimatorConfig(
         model_dir=tempfile.mkdtemp(prefix="etpu_serve_selftest_"),
@@ -143,17 +208,26 @@ def selftest() -> int:
     )
     est.train(log=False)
 
-    runtime = InferenceRuntime(model, flow, cfg, buckets=(16,))
-    runtime.warmup()
     all_ids = np.arange(1, n + 1, dtype=np.uint64)
     batches, chunks = id_batches(flow, all_ids, 16)
     _, direct = est.infer(batches, chunks)
 
-    server = ModelServer(runtime, max_wait_us=5000).start()
+    servers = []
+    for i in range(max(1, replicas)):
+        runtime = InferenceRuntime(model, mkflow(), cfg, buckets=(16,))
+        runtime.warmup()
+        servers.append(
+            ModelServer(runtime, max_wait_us=5000, shard=i).start()
+        )
+    addrs = [(s.host, s.port) for s in servers]
     results: dict = {}
 
     def worker(k: int):
-        client = ServingClient((server.host, server.port))
+        client = ServingClient(
+            addrs,
+            routing="consistent_hash" if len(addrs) > 1 else None,
+            hedge_ms=hedge_ms,
+        )
         try:
             ids = all_ids[k * 6 : (k + 1) * 6]
             results[k] = (ids, client.predict(ids))
@@ -171,16 +245,40 @@ def selftest() -> int:
         np.array_equal(emb, direct[ids.astype(np.int64) - 1])
         for ids, emb in results.values()
     )
-    stats_client = ServingClient((server.host, server.port))
+    stats_client = ServingClient(addrs)
     stats = stats_client.stats()
+    fleet = stats_client.fleet_stats()
+    reload_parity = None
+    if len(addrs) > 1:
+        # rolling hot reload of the same checkpoint: canary rows must be
+        # bit-identical pre/post swap on every replica
+        reports = stats_client.reload(canary_ids=all_ids[:16])
+        reload_parity = all(
+            r.get("canary_parity") is True for r in reports.values()
+        )
+        ok = ok and reload_parity and len(fleet) == len(addrs)
     stats_client.close()
-    server.stop()
-    print(json.dumps({
+    requests = sum(
+        s.get("requests", 0) for s in fleet.values() if "error" not in s
+    )
+    batches_n = sum(
+        s.get("batches", 0) for s in fleet.values() if "error" not in s
+    )
+    for s in servers:
+        s.stop()
+    out = {
         "selftest": "ok" if ok else "MISMATCH",
-        "requests": stats["requests"],
-        "batches": stats["batches"],
-        "coalesced": stats["batches"] < stats["requests"],
-    }))
+        "replicas": len(addrs),
+        "requests": requests if len(addrs) > 1 else stats["requests"],
+        "batches": batches_n if len(addrs) > 1 else stats["batches"],
+        "coalesced": (
+            (batches_n if len(addrs) > 1 else stats["batches"])
+            < (requests if len(addrs) > 1 else stats["requests"])
+        ),
+    }
+    if reload_parity is not None:
+        out["reload_parity"] = reload_parity
+    print(json.dumps(out))
     return 0 if ok else 1
 
 
@@ -207,26 +305,58 @@ def main(argv=None) -> int:
     ap.add_argument("--max-wait-us", type=int, default=2000)
     ap.add_argument("--max-queue", type=int, default=256)
     ap.add_argument("--registry", default=None)
-    ap.add_argument("--replica", type=int, default=0)
+    ap.add_argument("--replica", type=int, default=0,
+                    help="shard index of the FIRST replica (registry key)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="number of ModelServer replicas to boot")
+    ap.add_argument("--hedge", type=float, default=None, metavar="MS",
+                    help="recommended client hedge delay for this fleet "
+                         "(ms; default p95-tracked, EULER_TPU_HEDGE_MS)")
+    ap.add_argument("--reload", action="store_true",
+                    help="watch --model-dir and hot-swap every replica "
+                         "when a new checkpoint lands (zero downtime)")
     ap.add_argument("--native", action="store_true")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.replicas < 1:
+        ap.error("--replicas must be >= 1")
     if args.selftest:
-        return selftest()
+        return selftest(replicas=args.replicas, hedge_ms=args.hedge)
     if not args.data or not args.model_dir:
         ap.error("--data and --model-dir are required (or --selftest)")
-    server = serve_model(build_runtime(args), args)
+    servers = serve_fleet(args)
+    for server in servers:
+        print(
+            f"serving model on {server.host}:{server.port} "
+            f"(replica {server.shard}, buckets {server.runtime.buckets}, "
+            f"max_batch {server.batcher.max_batch}, max_wait "
+            f"{int(server.batcher.max_wait_s * 1e6)}us)",
+            flush=True,
+        )
     print(
-        f"serving model on {server.host}:{server.port} "
-        f"(buckets {server.runtime.buckets}, max_batch "
-        f"{server.batcher.max_batch}, max_wait "
-        f"{int(server.batcher.max_wait_s * 1e6)}us)",
+        json.dumps({
+            "fleet": [f"{s.host}:{s.port}" for s in servers],
+            "routing": "consistent_hash",
+            "hedge_ms": args.hedge,
+            "hot_reload": bool(args.reload),
+        }),
         flush=True,
     )
+    stop_event = threading.Event()
+    if args.reload:
+        threading.Thread(
+            target=watch_reload,
+            args=(servers, args.model_dir, stop_event,
+                  float(os.environ.get("EULER_TPU_RELOAD_POLL_S", 10.0))),
+            daemon=True,
+            name="ckpt-reload-watch",
+        ).start()
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
-        server.stop()
+        stop_event.set()
+        for server in servers:
+            server.stop()
     return 0
 
 

@@ -72,6 +72,11 @@ def test_gate_covers_the_package():
     for must in (
         "euler_tpu/serving/batcher.py",
         "euler_tpu/serving/server.py",
+        # the serving-fleet lane (ISSUE 7): hedge/quota shared state is
+        # exactly what lock-discipline + unbounded-cache exist to audit
+        "euler_tpu/serving/router.py",
+        "euler_tpu/serving/client.py",
+        "euler_tpu/serving/runtime.py",
         "euler_tpu/distributed/service.py",
         "euler_tpu/distributed/client.py",
         "euler_tpu/distributed/chaos.py",

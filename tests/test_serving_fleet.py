@@ -1,0 +1,638 @@
+"""Serving fleet (ISSUE 7): replicated routing, budget-capped hedging,
+per-tenant quotas, chaos failover, and zero-downtime hot reload.
+
+The contract under test: routed/hedged/unhedged predictions are all
+bit-identical to offline `Estimator.infer`; consistent-hash assignment
+is stable under replica-list order; hedges stop when the token bucket
+runs dry; one tenant's overload never rejects another tenant; a replica
+killed mid-load fails over with no typed-error leak; and a hot reload
+proves canary bit-parity with zero dropped or errored in-flight
+requests.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.dataflow import FullNeighborDataFlow
+from euler_tpu.distributed import Fault, FaultPlan, chaos
+from euler_tpu.distributed.retry import RetryBudget
+from euler_tpu.estimator import (
+    Estimator,
+    EstimatorConfig,
+    id_batches,
+    node_batches,
+)
+from euler_tpu.graph import Graph
+from euler_tpu.models import GraphSAGESupervised
+from euler_tpu.serving import (
+    InferenceRuntime,
+    ModelServer,
+    OverloadError,
+    ServingClient,
+    ServingRouter,
+    TenantQuota,
+)
+from euler_tpu.serving.router import (
+    ConsistentHashPolicy,
+    LeastLoadedPolicy,
+    _ReplicaState,
+)
+
+N_NODES = 48
+BUCKET = 16
+REPLICAS = 3
+ALL_IDS = np.arange(1, N_NODES + 1, dtype=np.uint64)
+
+
+def _ring_graph(n=N_NODES, seed=0):
+    rng = np.random.default_rng(seed)
+    nodes = [
+        {
+            "id": i + 1,
+            "type": 0,
+            "weight": 1.0,
+            "features": [
+                {"name": "feat", "type": "dense",
+                 "value": rng.normal(size=4).tolist()},
+                {"name": "label", "type": "dense",
+                 "value": [1.0, 0.0] if i % 2 else [0.0, 1.0]},
+            ],
+        }
+        for i in range(n)
+    ]
+    edges = [
+        {"src": i + 1, "dst": (i + d) % n + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for i in range(n)
+        for d in (1, 2, 3)
+    ]
+    return Graph.from_json({"nodes": nodes, "edges": edges})
+
+
+def _mkflow(graph):
+    # deterministic per root — the precondition for every bit-parity
+    # claim below (each replica answers from an identical subgraph)
+    return FullNeighborDataFlow(
+        graph, ["feat"], num_hops=2, max_degree=4, label_feature="label"
+    )
+
+
+class Fleet:
+    """One trained checkpoint served by REPLICAS in-process servers."""
+
+    def __init__(self, tmp_dir):
+        self.graph = _ring_graph()
+        self.flow = _mkflow(self.graph)
+        self.model = GraphSAGESupervised(dims=[8, 8], label_dim=2)
+        self.cfg = EstimatorConfig(
+            model_dir=str(tmp_dir / "ckpt"), total_steps=2, log_steps=10**9
+        )
+        self.est = Estimator(
+            self.model,
+            node_batches(self.graph, self.flow, BUCKET,
+                         rng=np.random.default_rng(1)),
+            self.cfg,
+        )
+        self.est.train(log=False)
+        batches, chunks = id_batches(self.flow, ALL_IDS, BUCKET)
+        _, self.direct = self.est.infer(batches, chunks)
+        self.servers = []
+        for i in range(REPLICAS):
+            runtime = InferenceRuntime(
+                self.model, _mkflow(self.graph), self.cfg, buckets=(BUCKET,)
+            )
+            runtime.warmup()
+            self.servers.append(
+                ModelServer(runtime, max_wait_us=2000, shard=i).start()
+            )
+        self.addrs = [(s.host, s.port) for s in self.servers]
+
+    def rows(self, ids):
+        return self.direct[np.asarray(ids, np.int64) - 1]
+
+    def spawn(self, n, shard0=100):
+        """Extra disposable servers over the same params (tests that kill
+        or reload replicas must never touch the shared fixture fleet)."""
+        out = []
+        for i in range(n):
+            runtime = InferenceRuntime(
+                self.model, _mkflow(self.graph), self.cfg,
+                params=self.est.params, buckets=(BUCKET,),
+            )
+            runtime.warmup()
+            out.append(
+                ModelServer(
+                    runtime, max_wait_us=2000, shard=shard0 + i
+                ).start()
+            )
+        return out
+
+    def stop(self):
+        for s in self.servers:
+            s.stop()
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    f = Fleet(tmp_path_factory.mktemp("fleet"))
+    yield f
+    f.stop()
+
+
+# ---------------------------------------------------------------------------
+# routing policies
+# ---------------------------------------------------------------------------
+
+
+def test_consistent_hash_stable_under_replica_list_order():
+    """The ring is keyed by replica ADDRESS: shuffling the replica list
+    must not move a single assignment (cache/bucket affinity survives
+    config-file reorder), and keys must actually spread."""
+    addrs = [("10.0.0.1", 9000), ("10.0.0.2", 9000), ("10.0.0.3", 9000)]
+
+    def policy(order):
+        states = [
+            _ReplicaState(h, p, i) for i, (h, p) in enumerate(order)
+        ]
+        return ConsistentHashPolicy(states)
+
+    a = policy(addrs)
+    b = policy(addrs[::-1])
+    primaries = set()
+    for k in range(64):
+        ids = np.roll(ALL_IDS, 5 * k)[:6]
+        oa = [st.key() for st in a.order(ids)]
+        ob = [st.key() for st in b.order(ids)]
+        assert oa == ob, f"assignment moved under list reorder: {oa} != {ob}"
+        primaries.add(oa[0])
+    assert len(primaries) > 1, "consistent hash routed everything onto one replica"
+
+
+def test_least_loaded_ranks_by_load_signals():
+    states = [
+        _ReplicaState("h", 1, 0), _ReplicaState("h", 2, 1),
+        _ReplicaState("h", 3, 2),
+    ]
+    states[0].inflight = 2
+    states[1].queue_depth = 5
+    order = LeastLoadedPolicy(states).order(np.ones(1, np.uint64))
+    assert [st.port for st in order] == [3, 2, 1]
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError, match="unknown routing policy"):
+        ServingRouter([("127.0.0.1", 1)], policy="no_such_policy")
+
+
+# ---------------------------------------------------------------------------
+# routed + hedged bit-parity
+# ---------------------------------------------------------------------------
+
+
+def test_routed_predict_bit_parity_both_policies(fleet):
+    for policy in ("consistent_hash", "least_loaded"):
+        client = ServingClient(fleet.addrs, routing=policy)
+        try:
+            for k in range(6):
+                ids = np.roll(ALL_IDS, 7 * k)[:6]
+                emb = client.predict(ids)
+                assert emb.dtype == fleet.direct.dtype
+                assert np.array_equal(emb, fleet.rows(ids)), (policy, k)
+        finally:
+            client.close()
+
+
+def test_hedged_unhedged_single_replica_all_bit_identical(fleet):
+    """The acceptance triple: hedged == unhedged == single-replica
+    Estimator.infer rows. hedge_ms=0 forces a hedge on EVERY request, so
+    the equality holds with hedges genuinely racing the primaries."""
+    ids_sets = [np.roll(ALL_IDS, 11 * k)[:6] for k in range(8)]
+    single = ServingClient([fleet.addrs[0]])
+    unhedged = ServingClient(
+        fleet.addrs, routing=ServingRouter(fleet.addrs, hedge=False)
+    )
+    hedged = ServingClient(
+        fleet.addrs,
+        routing=ServingRouter(fleet.addrs, hedge=True, hedge_ms=0.0),
+    )
+    try:
+        for ids in ids_sets:
+            a = single.predict(ids)
+            b = unhedged.predict(ids)
+            c = hedged.predict(ids)
+            assert np.array_equal(a, fleet.rows(ids))
+            assert np.array_equal(a, b) and np.array_equal(b, c)
+        assert hedged.router.stats()["hedges"] >= 1
+    finally:
+        single.close()
+        unhedged.close()
+        hedged.close()
+
+
+# ---------------------------------------------------------------------------
+# hedging: straggler mitigation + token-bucket storm stop
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_beats_seeded_straggler_within_budget(fleet):
+    """One replica stalls (chaos server-delay on its predict dispatch);
+    hedged answers stay bit-identical and fast, and the hedge count
+    stays inside what the token bucket can cover."""
+    chaos.install(FaultPlan([
+        Fault(site="server", kind="delay", op="predict", shard=1,
+              delay_s=0.25),
+    ], seed=3))
+    client = ServingClient(
+        fleet.addrs,
+        routing=ServingRouter(
+            fleet.addrs, policy="consistent_hash", hedge=True, hedge_ms=15.0
+        ),
+    )
+    try:
+        lats = []
+        for k in range(18):
+            ids = np.roll(ALL_IDS, 5 * k)[:6]
+            t0 = time.monotonic()
+            emb = client.predict(ids)
+            lats.append(time.monotonic() - t0)
+            assert np.array_equal(emb, fleet.rows(ids)), k
+        st = client.router.stats()
+        assert st["hedges"] >= 1, st
+        assert st["hedges_won"] >= 1, st
+        assert st["hedges_denied"] == 0, st
+        cap = client.router._hedge_budget.cap
+        assert st["hedges"] <= cap + 0.5 * st["requests"], st
+        # every straggler-bound request was rescued by its hedge: no
+        # answer waited for the full injected stall
+        assert max(lats) < 0.25, max(lats)
+    finally:
+        client.close()
+        chaos.uninstall()
+
+
+def test_hedge_budget_stops_storm(fleet):
+    """Whole fleet degraded (every replica's predict delayed): a dry
+    token bucket must stop hedging — duplicate load is exactly wrong —
+    while the original requests still answer correctly."""
+    chaos.install(FaultPlan([
+        Fault(site="server", kind="delay", op="predict", delay_s=0.1),
+    ], seed=4))
+    client = ServingClient(
+        fleet.addrs,
+        routing=ServingRouter(
+            fleet.addrs, hedge=True, hedge_ms=5.0,
+            hedge_budget=RetryBudget(cap=2.0, refill=0.0),
+        ),
+    )
+    try:
+        for k in range(6):
+            ids = np.roll(ALL_IDS, 9 * k)[:6]
+            assert np.array_equal(client.predict(ids), fleet.rows(ids))
+        st = client.router.stats()
+        assert st["hedges"] == 2, st  # cap, no refill -> exactly 2 spends
+        assert st["hedges_denied"] >= 1, st
+        assert client.router._hedge_budget.denied >= 1
+    finally:
+        client.close()
+        chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant quotas
+# ---------------------------------------------------------------------------
+
+
+class _GatedRuntime:
+    """Device blocked until the test opens the gate — quota behavior
+    becomes deterministic, not timing-dependent."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.device_batches = 0
+        self.buckets = (8,)
+
+    def predict(self, ids):
+        assert self.gate.wait(timeout=30), "test never opened the gate"
+        self.device_batches += 1
+        return np.zeros((len(ids), 2), np.float32)
+
+
+def test_tenant_quota_isolation_over_the_wire():
+    """Tenant A floods a gated server past its pending share: A's
+    rejections are typed OverloadErrors NAMING tenant A, the global
+    queue never fills, and tenant B's request sails through."""
+    runtime = _GatedRuntime()
+    server = ModelServer(
+        runtime, max_batch=1, max_wait_us=0, max_queue=32, workers=16,
+        tenant_quota=TenantQuota(max_pending=2),
+    ).start()
+    outcomes: dict = {}
+
+    def attempt(key, tenant):
+        client = ServingClient((server.host, server.port))
+        try:
+            client.predict(np.ones(1, np.uint64), tenant=tenant)
+            outcomes[key] = "ok"
+        except OverloadError as e:
+            outcomes[key] = f"overload:{e}"
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=attempt, args=(k, "A")) for k in range(6)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10
+        while (
+            sum("overload" in v for v in outcomes.values()) < 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        rejected = [v for v in outcomes.values() if "overload" in v]
+        assert len(rejected) >= 4, outcomes
+        assert all("tenant 'A'" in v for v in rejected), (
+            "tenant A's overload must be typed per tenant, not global:"
+            f" {outcomes}"
+        )
+        # tenant B admitted while A is saturated and the device is
+        # provably still blocked
+        tb = threading.Thread(target=attempt, args=("B", "B"))
+        tb.start()
+        time.sleep(0.2)
+        assert runtime.device_batches == 0
+        runtime.gate.set()
+        for t in threads:
+            t.join()
+        tb.join()
+        assert outcomes["B"] == "ok", outcomes
+        stats = ServingClient((server.host, server.port))
+        tenants = stats.stats()["tenants"]
+        stats.close()
+        assert tenants["A"]["rejected"] >= 4
+        assert tenants["B"]["rejected"] == 0
+        assert tenants["B"]["admitted"] == 1
+    finally:
+        runtime.gate.set()
+        for t in threads:
+            t.join()
+        server.stop()
+
+
+def test_tenant_quota_qps_bucket_unit():
+    q = TenantQuota(qps=1e-6, burst=2)  # ~no refill inside the test
+    q.admit("a")
+    q.admit("a")
+    with pytest.raises(OverloadError, match="tenant 'a'.*qps quota"):
+        q.admit("a")
+    q.admit("b")  # a's exhaustion never touches b
+    s = q.stats()
+    assert s["a"]["rejected"] == 1 and s["b"]["rejected"] == 0
+
+
+def test_tenant_quota_tracking_is_bounded():
+    q = TenantQuota(qps=1000.0)
+    q.MAX_TRACKED = 8
+    for i in range(50):
+        q.admit(f"t{i}")
+        q.release(f"t{i}")
+    assert len(q.stats()) <= 8
+
+
+def test_untenanted_requests_bypass_quota(fleet):
+    """tenant=None keeps the PR-2 contract: no quota accounting at all."""
+    client = ServingClient([fleet.addrs[0]])
+    try:
+        ids = ALL_IDS[:4]
+        assert np.array_equal(client.predict(ids), fleet.rows(ids))
+        assert "tenants" not in client.stats() or not client.stats().get(
+            "tenants"
+        )
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: replica kill mid-load
+# ---------------------------------------------------------------------------
+
+
+def test_replica_kill_mid_load_fails_over_without_typed_leak(fleet):
+    """A replica hard-killed under concurrent load costs transport
+    failovers, never a client-visible error — typed or otherwise — and
+    every answer stays bit-identical."""
+    servers = fleet.spawn(3, shard0=50)
+    addrs = [(s.host, s.port) for s in servers]
+    router = ServingRouter(addrs, policy="consistent_hash", hedge=False,
+                           quarantine_s=5.0)
+    client = ServingClient(addrs, routing=router)
+    errors: list = []
+    done = [0] * 4
+    kill_at = threading.Barrier(5)
+
+    def worker(k):
+        try:
+            kill_at.wait(timeout=10)
+            for j in range(12):
+                ids = np.roll(ALL_IDS, 13 * k + j)[:6]
+                emb = client.predict(ids)
+                if not np.array_equal(emb, fleet.rows(ids)):
+                    errors.append(f"mismatch {k},{j}")
+                    return
+                done[k] += 1
+        except Exception as e:
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        kill_at.wait(timeout=10)  # all workers in flight together
+        time.sleep(0.05)
+        servers[1].stop()  # hard kill, no drain
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        assert done == [12] * 4, done
+        assert router.stats()["failovers"] >= 1
+        pings = client.ping_all()
+        assert sum(pings.values()) == 2, pings
+    finally:
+        client.close()
+        for i, s in enumerate(servers):
+            if i != 1:
+                s.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero-downtime hot reload
+# ---------------------------------------------------------------------------
+
+
+def test_hot_reload_canary_parity_with_zero_inflight_drops(fleet):
+    """Rolling reload of the SAME checkpoint under concurrent load: the
+    canary rows are bit-identical pre/post swap on every replica, and
+    not one in-flight request dropped, errored, or changed bits."""
+    servers = fleet.spawn(2, shard0=60)
+    addrs = [(s.host, s.port) for s in servers]
+    client = ServingClient(addrs, routing="consistent_hash")
+    stop = time.monotonic() + 2.5
+    errors: list = []
+    counts = [0] * 3
+
+    def load(k):
+        lc = ServingClient(addrs, routing="consistent_hash")
+        rng = np.random.default_rng(200 + k)
+        try:
+            while time.monotonic() < stop:
+                ids = rng.choice(ALL_IDS, size=6, replace=False)
+                emb = lc.predict(ids)
+                if not np.array_equal(emb, fleet.rows(ids)):
+                    errors.append(f"mismatch in loader {k}")
+                    return
+                counts[k] += 1
+        except Exception as e:
+            errors.append(repr(e))
+        finally:
+            lc.close()
+
+    threads = [threading.Thread(target=load, args=(k,)) for k in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # loaders in flight before the swap begins
+        reports = client.reload(canary_ids=ALL_IDS[:BUCKET])
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        assert min(counts) > 0, counts
+        assert len(reports) == 2 and all(
+            r.get("canary_parity") is True for r in reports.values()
+        ), reports
+        fs = client.fleet_stats()
+        assert all(s["reloads"] == 1 for s in fs.values()), fs
+        assert all(s["errors"] == 0 for s in fs.values()), fs
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_hot_reload_swaps_to_new_checkpoint_atomically(fleet, tmp_path):
+    """Reloading a DIFFERENT checkpoint: post-swap predictions are
+    bit-identical to offline infer on the NEW weights (and differ from
+    the old ones — the swap observably happened)."""
+    est2 = Estimator(
+        fleet.model,
+        node_batches(fleet.graph, fleet.flow, BUCKET,
+                     rng=np.random.default_rng(9)),
+        EstimatorConfig(
+            model_dir=str(tmp_path / "ckpt2"), total_steps=4,
+            log_steps=10**9,
+        ),
+        init_params=fleet.est.params,
+    )
+    est2.train(log=False)
+    batches, chunks = id_batches(fleet.flow, ALL_IDS, BUCKET)
+    _, direct2 = est2.infer(batches, chunks)
+    assert not np.array_equal(direct2, fleet.direct)
+
+    servers = fleet.spawn(1, shard0=70)
+    client = ServingClient((servers[0].host, servers[0].port))
+    try:
+        ids = ALL_IDS[:8]
+        before = client.predict(ids)
+        assert np.array_equal(before, fleet.rows(ids))
+        report = client.reload(model_dir=str(tmp_path / "ckpt2"))
+        rep = next(iter(report.values()))
+        assert rep["reloaded"] is True and rep["warmed_buckets"] == [BUCKET]
+        after = client.predict(ids)
+        assert np.array_equal(after, direct2[ids.astype(np.int64) - 1])
+        assert not np.array_equal(after, before)
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet operator surface + load signals
+# ---------------------------------------------------------------------------
+
+
+def test_server_stats_load_signals(fleet):
+    client = ServingClient(fleet.addrs)
+    try:
+        ids = ALL_IDS[:6]
+        client.predict(ids)
+        for stats in client.fleet_stats().values():
+            assert stats["inflight"] == 0
+            assert stats["queue_depth"] == 0
+            assert "ewma_batch_ms" in stats and "reloads" in stats
+        # at least the replica that served the request has a latency EWMA
+        assert any(
+            s["ewma_batch_ms"] > 0 for s in client.fleet_stats().values()
+        )
+    finally:
+        client.close()
+
+
+def test_inflight_signal_counts_admitted_unanswered():
+    runtime = _GatedRuntime()
+    server = ModelServer(
+        runtime, max_batch=1, max_wait_us=0, max_queue=8, workers=8
+    ).start()
+    client = ServingClient((server.host, server.port))
+    try:
+        hold = threading.Thread(
+            target=lambda: client.predict(np.ones(1, np.uint64))
+        )
+        hold.start()
+        deadline = time.monotonic() + 10
+        seen = 0
+        while time.monotonic() < deadline:
+            seen = client.stats()["inflight"]
+            if seen >= 1:
+                break
+            time.sleep(0.01)
+        assert seen >= 1
+        runtime.gate.set()
+        hold.join()
+        assert client.stats()["inflight"] == 0
+    finally:
+        runtime.gate.set()
+        client.close()
+        server.stop()
+
+
+def test_fleet_stats_and_ping_all_see_every_replica(fleet):
+    # a dead address must show as an error/False entry, never vanish
+    dead = ("127.0.0.1", 1)
+    client = ServingClient(fleet.addrs + [dead])
+    try:
+        fs = client.fleet_stats()
+        assert len(fs) == REPLICAS + 1
+        live = [k for k, v in fs.items() if "error" not in v]
+        assert len(live) == REPLICAS
+        assert all("requests" in fs[k] for k in live)
+        assert "error" in fs["127.0.0.1:1"]
+        pings = client.ping_all()
+        assert pings["127.0.0.1:1"] is False
+        assert sum(pings.values()) == REPLICAS
+    finally:
+        client.close()
+
+
+def test_serve_selftest_fleet_inprocess(capsys):
+    """`serve --selftest --replicas 2 --hedge 5`'s engine, in-process:
+    fleet boot + routed parity + rolling reload parity, exit code 0."""
+    from euler_tpu.tools import serve
+
+    assert serve.selftest(replicas=2, hedge_ms=5.0) == 0
+    out = capsys.readouterr().out
+    assert '"selftest": "ok"' in out
+    assert '"reload_parity": true' in out
