@@ -487,6 +487,127 @@ def _paged_device_ab(smoke: bool) -> dict:
     }
 
 
+def _mutation_lane(smoke: bool) -> dict:
+    """Streaming-mutation lane (ISSUE 8; EULER_BENCH_MUTATION=0 opt-out):
+    sustained writer upserts/s into the per-shard delta buffers, publish
+    latency at two delta sizes, post-publish read recovery (the first
+    read pays the merged store's lazy sampler/index rebuilds), and the
+    standing merged == from-scratch bit-parity oracle — reads stay
+    epoch-consistent while the writer streams, and every published
+    epoch equals a cold build of the mutated graph."""
+    from euler_tpu.distributed.writer import GraphWriter
+    from euler_tpu.graph import Graph
+    from euler_tpu.graph.builder import build_from_json
+
+    n, stream_small, stream_large = (
+        (400, 400, 2000) if smoke else (5000, 5000, 25000)
+    )
+    rng = np.random.default_rng(11)
+    nodes = [
+        {"id": i + 1, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense",
+                       "value": rng.normal(size=8).tolist()}]}
+        for i in range(n)
+    ]
+    # unique (src, dst, type) keys by construction: upsert semantics
+    # target ONE edge per key, so the from-scratch replay must too
+    edges = [
+        {"src": s, "dst": (s + off) % n + 1, "type": 0,
+         "weight": float(rng.integers(1, 5)), "features": []}
+        for s in range(1, n + 1)
+        for off in (1, 3, 7)
+    ]
+    data = {"nodes": nodes, "edges": edges}
+    g = Graph.from_json(data, num_partitions=2)
+    read_ids = np.arange(1, min(n, 256) + 1, dtype=np.uint64)
+
+    def read_rate(reps: int = 10) -> float:
+        t0 = time.perf_counter()
+        for k in range(reps):
+            g.get_dense_feature(read_ids, ["feat"])
+            g.sample_neighbor(
+                read_ids, None, 5, rng=np.random.default_rng(k)
+            )
+        return reps / (time.perf_counter() - t0)
+
+    pre_rate = read_rate()
+
+    def mk_stream(k: int, seed: int):
+        r = np.random.default_rng(seed)
+        return (
+            r.integers(1, n + 1, size=k).astype(np.uint64),
+            r.integers(1, n + 1, size=k).astype(np.uint64),
+            r.integers(1, 9, size=k).astype(np.float32),
+        )
+
+    writer = GraphWriter(g, batch_rows=1024)
+    streams = [mk_stream(stream_large, 21), mk_stream(stream_small, 22)]
+    # sustained staging throughput: client batching + scatter + per-shard
+    # delta appends, publish excluded
+    src, dst, w = streams[0]
+    t0 = time.perf_counter()
+    for lo in range(0, stream_large, 1024):
+        writer.upsert_edges(
+            src[lo : lo + 1024], dst[lo : lo + 1024], None,
+            w[lo : lo + 1024],
+        )
+    writer.flush()
+    stage_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    writer.publish()
+    publish_large_ms = (time.perf_counter() - t0) * 1e3
+    src, dst, w = streams[1]
+    writer.upsert_edges(src, dst, None, w)
+    writer.flush()
+    t0 = time.perf_counter()
+    writer.publish()
+    publish_small_ms = (time.perf_counter() - t0) * 1e3
+    # post-publish read recovery: the first read batch pays the merged
+    # store's lazy rebuilds (edge-key index, samplers), then steady state
+    t0 = time.perf_counter()
+    g.get_dense_feature(read_ids, ["feat"])
+    g.sample_neighbor(read_ids, None, 5, rng=np.random.default_rng(0))
+    recovery_ms = (time.perf_counter() - t0) * 1e3
+    post_rate = read_rate()
+    # bit parity: replay the same streams onto the JSON, rebuild cold
+    ref_edges = [dict(e) for e in edges]
+    index = {(e["src"], e["dst"], e["type"]): e for e in ref_edges}
+    for src, dst, w in streams:
+        for s_, d_, w_ in zip(src, dst, w):
+            key = (int(s_), int(d_), 0)
+            rec = index.get(key)
+            if rec is None:
+                rec = {"src": key[0], "dst": key[1], "type": 0,
+                       "weight": float(w_), "features": []}
+                ref_edges.append(rec)
+                index[key] = rec
+            else:
+                rec["weight"] = float(w_)
+    _, ref_shards = build_from_json(
+        {"nodes": nodes, "edges": ref_edges}, 2
+    )
+    parity = all(
+        np.array_equal(
+            np.asarray(g.shards[p].arrays[k]), np.asarray(ref_shards[p][k])
+        )
+        for p in range(2)
+        for k in ref_shards[p]
+    )
+    return {
+        "mutation": True,
+        "mutation_upserts_per_sec": round(stream_large / stage_s, 1),
+        "mutation_publish_ms_small": round(publish_small_ms, 2),
+        "mutation_publish_ms_large": round(publish_large_ms, 2),
+        "mutation_publish_rows_small": int(stream_small),
+        "mutation_publish_rows_large": int(stream_large),
+        "mutation_read_recovery_ms": round(recovery_ms, 2),
+        "mutation_read_rate_post_over_pre": round(
+            post_rate / max(pre_rate, 1e-9), 3
+        ),
+        "mutation_bit_parity": bool(parity),
+    }
+
+
 def run(platform: str) -> tuple[float, dict]:
     from euler_tpu.dataflow import SageDataFlow
     from euler_tpu.datasets.synthetic import random_graph
@@ -622,6 +743,16 @@ def run(platform: str) -> tuple[float, dict]:
 
             traceback.print_exc()
             extra.update({"paged": False, "paged_error": repr(e)[:300]})
+    # streaming-mutation lane (ISSUE 8) — writer throughput, publish
+    # latency, read recovery, and the merged==from-scratch parity oracle
+    if os.environ.get("EULER_BENCH_MUTATION", "1") != "0":
+        try:
+            extra.update(_mutation_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update({"mutation": False, "mutation_error": repr(e)[:300]})
     probe = _probe_meta()
     if probe:
         extra["probe"] = probe

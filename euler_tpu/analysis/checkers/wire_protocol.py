@@ -64,6 +64,9 @@ DEFAULT_DOMAINS = (
         clients=(
             "euler_tpu/distributed/client.py",
             "euler_tpu/query/plan.py",
+            # the streaming-mutation writer (ISSUE 8): upsert/delete/
+            # publish verbs ride the same protocol
+            "euler_tpu/distributed/writer.py",
         ),
         servers=("euler_tpu/distributed/service.py",),
     ),

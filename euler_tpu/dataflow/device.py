@@ -290,6 +290,12 @@ class DeviceGraphTables:
                 "local shards or remote shards (wire staging)"
             )
         ids, wn, nt = _node_table(graph)
+        # kept host-side for refresh_rows: the published-mutation restage
+        # resolves global rows back to ids and re-fetches their adjacency
+        self._ids_host = ids
+        self._edge_types = (
+            None if edge_types is None else [int(t) for t in edge_types]
+        )
         self._stage_adjacency(
             graph, ids, edge_types, max_degree, stage_types,
             layout=layout, page_size=page_size,
@@ -460,6 +466,7 @@ class DeviceGraphTables:
             flat_w[dest] = np.concatenate(w_p)
             flat_q[dest] = np.concatenate(q_p)
         self.pages2d = _as_lane_rows(jnp.asarray(flat))
+        self._ps_host = ps  # page table, host copy (refresh_rows spans)
         self.page_start = jax.device_put(ps.astype(np.int32))
         self.deg = jax.device_put(deg)
         self.unit_w = unit_w
@@ -488,6 +495,147 @@ class DeviceGraphTables:
         # dense planes absent on purpose: flows that need them are gated
         # by _PAGED_OK at staging time
         self.adj = self.wtab = self.qtab = self.ttab = None
+
+    # -- published-mutation restage --------------------------------------
+
+    def refresh_rows(self, graph, rows) -> int:
+        """Re-stage ONLY the given GLOBAL node rows after a published
+        graph mutation (feed it ``GraphWriter.publish()["rows"]``) — the
+        adjacency twin of ``DeviceFeatureCache.refresh_rows``. Dense
+        layout patches the touched ``[row]`` slices of the adj/deg/
+        weight planes; paged layout re-packs only the ⌈deg/P⌉ pages of
+        the mutated rows (page-granular, the Ragged-Paged-Attention
+        indirection shape). Structural changes a patch cannot express —
+        node count changed, a degree outgrowing its staged capacity, or
+        a unit-weight staging turning weighted — raise ValueError: build
+        a fresh flow for those. Post-restage draws are bit-identical to
+        a from-scratch staging of the merged graph under the same key
+        (pinned by tests/test_delta.py). Returns rows re-staged."""
+        rows = np.unique(np.asarray(rows, dtype=np.int64).reshape(-1))
+        rows = rows[rows >= 0]
+        if not len(rows):
+            return 0
+        total = int(sum(int(s.num_nodes) for s in graph.shards))
+        if total != self.num_nodes:
+            raise ValueError(
+                f"node count changed ({self.num_nodes} staged, {total} "
+                "now) — a row patch cannot re-shape the staged tables; "
+                "build a fresh device flow"
+            )
+        if int(rows.max()) >= self.num_nodes:
+            raise ValueError("refresh_rows: row out of range")
+        ids = self._ids_host[rows]
+        degs = np.asarray(
+            graph.degree_sum(ids, self._edge_types), np.int64
+        )
+        if self.layout == "paged":
+            return self._refresh_paged(graph, rows, ids, degs)
+        return self._refresh_dense(graph, rows, ids, degs)
+
+    def _refresh_block(self, graph, ids, cap: int):
+        """Chunk of the staging sweep for a row subset: compacted
+        neighbor block + weights + degree + strength, the exact shapes
+        `_stage_adjacency`/`_stage_paged` put in the tables."""
+        nbr, w, tt, mask, _ = graph.get_full_neighbor(
+            ids, self._edge_types, max_degree=cap
+        )
+        rws = graph.lookup_rows(nbr.ravel()).reshape(nbr.shape)
+        blk0 = np.where(mask & (rws >= 0), rws + 1, 0).astype(np.int32)
+        order = np.argsort(blk0 == 0, axis=1, kind="stable")
+        block = np.take_along_axis(blk0, order, axis=1)
+        wblk = np.take_along_axis(
+            np.where(blk0 > 0, w, 0.0).astype(np.float32), order, axis=1
+        )
+        ttb = np.take_along_axis(
+            np.where(blk0 > 0, tt, -1).astype(np.int32), order, axis=1
+        )
+        d = (block > 0).sum(axis=1).astype(np.int32)
+        st = wblk.sum(axis=1, dtype=np.float64)
+        d[st <= 0.0] = 0
+        unit = bool(np.all(w[mask] == 1.0)) if mask.any() else True
+        if self.unit_w and not unit:
+            raise ValueError(
+                "mutation introduced non-unit edge weights on a "
+                "unit-weight staging — build a fresh device flow"
+            )
+        return block, wblk, ttb, d, st
+
+    def _refresh_dense(self, graph, rows, ids, degs) -> int:
+        width = int(self.adj.shape[1])
+        if int(degs.max(initial=0)) > width:
+            raise ValueError(
+                f"mutated degree {int(degs.max())} outgrew the staged "
+                f"dense width {width} — build a fresh device flow (or "
+                "the paged layout, which has no width to outgrow)"
+            )
+        block, wblk, ttb, d, st = self._refresh_block(graph, ids, width)
+        r1 = rows + 1
+        self.adj = self.adj.at[r1].set(jnp.asarray(block))
+        self.deg = self.deg.at[r1].set(jnp.asarray(d))
+        self._out_strength[r1] = st
+        if self.ttab is not None:
+            self.ttab = self.ttab.at[r1].set(jnp.asarray(ttb))
+        if not self.unit_w:
+            valid = np.arange(width)[None, :] < d[:, None]
+            self.wtab = self.wtab.at[r1].set(jnp.asarray(wblk))
+            self.qtab = self.qtab.at[r1].set(
+                jnp.asarray(_quantize_rows(wblk, valid))
+            )
+        return len(rows)
+
+    def _refresh_paged(self, graph, rows, ids, degs) -> int:
+        P = self.page_size
+        ps = self._ps_host
+        r1 = rows + 1
+        alloc = ps[r1 + 1] - ps[r1]  # pages staged for each row
+        need = -(-degs // P)
+        if np.any(need > alloc):
+            over = rows[need > alloc][:4]
+            raise ValueError(
+                f"mutated degree outgrew the staged page allocation for "
+                f"rows {over.tolist()} (⌈deg/{P}⌉ pages are fixed at "
+                "staging) — build a fresh device flow"
+            )
+        cap = max(int(degs.max(initial=0)), 1)
+        block, wblk, _, d, st = self._refresh_block(graph, ids, cap)
+        # rewrite each row's WHOLE allocated span (stale tail slots and
+        # pages become padding), so only ⌈deg/P⌉ pages per mutated row
+        # are touched and untouched rows' pages never move
+        spans = (alloc * P).astype(np.int64)
+        total = int(spans.sum())
+        vals = np.zeros(total, np.int32)
+        wv = np.zeros(total, np.float32)
+        qv = np.full(total, _U32_MAX, dtype=np.uint32)
+        dest = np.repeat(ps[r1] * P, spans) + _segment_arange(spans)
+        src_rows = np.repeat(np.arange(len(rows)), spans)
+        src_cols = _segment_arange(spans)
+        put = src_cols < np.repeat(d.astype(np.int64), spans)
+        sr, sc = src_rows[put], np.minimum(src_cols[put], block.shape[1] - 1)
+        vals[put] = block[sr, sc]
+        wv[put] = wblk[sr, sc]
+        self.deg = self.deg.at[r1].set(jnp.asarray(d))
+        self._out_strength[r1] = st
+        lanes = int(self.pages2d.shape[1])
+        self.pages2d = self.pages2d.at[dest // lanes, dest % lanes].set(
+            jnp.asarray(vals)
+        )
+        if not self.unit_w:
+            valid = np.arange(block.shape[1])[None, :] < d[:, None]
+            q = _quantize_rows(wblk, valid)
+            qv[put] = q[sr, sc]
+            self.page_w2d = self.page_w2d.at[
+                dest // lanes, dest % lanes
+            ].set(jnp.asarray(wv))
+            self.page_q2d = self.page_q2d.at[
+                dest // lanes, dest % lanes
+            ].set(jnp.asarray(qv))
+            touched_pages = np.repeat(ps[r1], alloc) + _segment_arange(
+                alloc
+            )
+            self.page_bound = self.page_bound.at[touched_pages].set(
+                jnp.asarray(qv.reshape(-1, P).max(axis=1))
+            )
+        return len(rows)
 
     @property
     def _kimpl(self) -> str:

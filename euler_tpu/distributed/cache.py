@@ -52,6 +52,11 @@ import numpy as np
 # bytes objects) added to each block's payload bytes for the budget
 _ENTRY_OVERHEAD = 96
 
+# cache keys whose per-entry identifier is a LOCAL ROW, not a node id —
+# targeted publish invalidation (advance_epoch) must match them against
+# the merge's mutated-row set instead of its touched-id set
+_ROW_KEYED = frozenset({"dense_rows", "ids_rows"})
+
 
 def cache_enabled() -> bool:
     return os.environ.get("EULER_TPU_READ_CACHE", "1") != "0"
@@ -126,6 +131,59 @@ class ReadCache:
         if flush:
             self.clear()
 
+    def advance_epoch(self, epoch: int, ids=None, rows=None) -> None:
+        """Publish-driven epoch advance with EXACT invalidation: drop
+        only the blocks the merge reported stale (``ids`` for id-keyed
+        verbs, ``rows`` for row-keyed verbs like ``get_dense_by_rows``)
+        and keep everything else warm across the epoch boundary.
+
+        Falls back to a full flush when the targeted sets are unknown
+        (``ids`` and ``rows`` both None) or when the epoch did not
+        advance by exactly one from the last observed value — a skipped
+        epoch means some publish's stale set was never seen, so nothing
+        cached can be trusted. The epoch is published BEFORE any drop:
+        a concurrent fetch that started under the old epoch then fails
+        its insert-time epoch check instead of re-seeding stale bytes.
+        """
+        epoch = int(epoch)
+        with self._epoch_lock:
+            prior = self.epoch
+            self.epoch = epoch
+        if prior is not None and epoch == prior:
+            return  # idempotent re-publish (retried publish_epoch)
+        targeted = (
+            (ids is not None or rows is not None)
+            and prior is not None
+            and epoch == prior + 1
+        )
+        with self._epoch_lock:  # counter shares observe_epoch's guard
+            self.invalidations += 1
+        if not targeted:
+            self.clear()
+            return
+        id_set = (
+            {int(x) for x in np.asarray(ids).reshape(-1)}
+            if ids is not None
+            else set()
+        )
+        row_set = (
+            {int(x) for x in np.asarray(rows).reshape(-1)}
+            if rows is not None
+            else set()
+        )
+        for st in self._stripes:
+            with st.lock:
+                doomed = [
+                    k
+                    for k in st.map
+                    if k[1] in (
+                        row_set if k[0][0] in _ROW_KEYED else id_set
+                    )
+                ]
+                for k in doomed:
+                    b = st.map.pop(k)
+                    st.bytes -= sum(len(c) for c in b) + _ENTRY_OVERHEAD
+
     def clear(self) -> None:
         for st in self._stripes:
             with st.lock:
@@ -178,12 +236,22 @@ class ReadCache:
                         blocks[int(i)] = b
         return blocks
 
-    def _insert(self, key: tuple, ids: np.ndarray, blocks: list) -> None:
+    def _insert(
+        self, key: tuple, ids: np.ndarray, blocks: list, ep=None
+    ) -> None:
+        """Store blocks; `ep` is the epoch observed when their fetch
+        STARTED. A publish that lands mid-fetch publishes the new epoch
+        before dropping blocks, so the per-stripe `epoch != ep` check
+        below rejects the stale insert — without it, a slow fetch could
+        re-seed pre-publish bytes after the invalidation swept past
+        (the cross-epoch-mix race the hammer test pins)."""
         stripe_ids = self._stripe_of(ids)
         for s in np.unique(stripe_ids):
             st = self._stripes[int(s)]
             sel = np.nonzero(stripe_ids == s)[0]
             with st.lock:
+                if self.epoch != ep:
+                    return  # fetched under a superseded epoch: drop
                 for i in sel:
                     b = blocks[int(i)]
                     size = sum(len(c) for c in b) + _ENTRY_OVERHEAD
@@ -226,6 +294,7 @@ class ReadCache:
         ids = np.asarray(ids)
         if ids.size == 0:
             return [np.asarray(a) for a in fetch_fn(ids)]
+        ep = self.epoch  # stamp BEFORE the fetch (see _insert)
         uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
         blocks = self._probe(key, uniq)
         miss = [i for i, b in enumerate(blocks) if b is None]
@@ -237,7 +306,9 @@ class ReadCache:
             meta = self._register_meta(key, fetched)
             for j, i in enumerate(miss):
                 blocks[i] = tuple(a[j].tobytes() for a in fetched)
-            self._insert(key, uniq[np.asarray(miss)], [blocks[i] for i in miss])
+            self._insert(
+                key, uniq[np.asarray(miss)], [blocks[i] for i in miss], ep
+            )
         meta = self._meta[key]
         per_id = sum(m[2] for m in meta)
         out = []
@@ -261,6 +332,7 @@ class ReadCache:
         ids = np.asarray(ids)
         if ids.size == 0:
             return [list(c) for c in fetch_fn(ids)]
+        ep = self.epoch  # stamp BEFORE the fetch (see _insert)
         uniq, inv = np.unique(ids.reshape(-1), return_inverse=True)
         blocks = self._probe(key, uniq)
         miss = [i for i, b in enumerate(blocks) if b is None]
@@ -269,7 +341,9 @@ class ReadCache:
             fetched = fetch_fn(uniq[np.asarray(miss)])
             for j, i in enumerate(miss):
                 blocks[i] = tuple(c[j] for c in fetched)
-            self._insert(key, uniq[np.asarray(miss)], [blocks[i] for i in miss])
+            self._insert(
+                key, uniq[np.asarray(miss)], [blocks[i] for i in miss], ep
+            )
         ncomp = len(blocks[0])
         out = [[blocks[i][k] for i in inv] for k in range(ncomp)]
         miss_set = set(miss)
@@ -291,11 +365,12 @@ class ReadCache:
         ids = np.asarray(ids).reshape(-1)
         if ids.size == 0:
             return
+        ep = self.epoch  # write-back rows carry their response's epoch
         uniq, first = np.unique(ids, return_index=True)
         comps = [np.ascontiguousarray(a) for a in components]
         self._register_meta(key, comps)
         blocks = [tuple(a[i].tobytes() for a in comps) for i in first]
-        self._insert(key, uniq, blocks)
+        self._insert(key, uniq, blocks, ep)
 
     def _register_meta(self, key: tuple, fetched: list) -> list:
         with self._meta_lock:

@@ -454,6 +454,20 @@ class RemoteShard:
 
     # -- read cache plumbing --------------------------------------------
 
+    def on_publish(self, epoch, rows=None, ids=None, num_nodes=None):
+        """Writer-driven publish notification (`GraphWriter.publish`):
+        advance the read cache to the published epoch dropping EXACTLY
+        the stale blocks (`rows` for row-keyed verbs, `ids` for
+        id-keyed ones; None → full flush, e.g. a retried publish whose
+        first response was lost), and refresh the cached num_nodes so
+        shard-major row offsets track the merged table."""
+        if self._cache is not None:
+            self._cache.advance_epoch(epoch, ids=ids, rows=rows)
+        with self._lock:
+            if num_nodes is not None:
+                self._num_nodes = int(num_nodes)
+            self._epoch_checked = True
+
     def refresh_epoch(self) -> int:
         """Re-read the server's graph_epoch; a mismatch flushes the read
         cache (mutable graphs must never serve stale bytes). Returns the

@@ -395,6 +395,16 @@ class GraphService:
         # updates race benignly across pool workers — it is telemetry,
         # not an invariant.
         self.op_counts: collections.Counter = collections.Counter()
+        # streaming-mutation state (graph/delta.py): staged writes are
+        # invisible to readers until publish_epoch merges them and swaps
+        # self.store in ONE reference assignment (dispatch binds
+        # `s = self.store` once per request, so reads are never torn).
+        # _applied is the bounded idempotency-key window that makes
+        # retried writer batches apply-once, across publishes included;
+        # all three fields are guarded by _delta_lock.
+        self._delta = None
+        self._applied: collections.OrderedDict = collections.OrderedDict()
+        self._delta_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -463,6 +473,7 @@ class GraphService:
         "condition_mask",
         "condition_weight",
         "degree_sum",
+        "delete_edges",
         "dense_feature_udf",
         "exec_plan",
         "get_binary_feature",
@@ -483,6 +494,7 @@ class GraphService:
         "node_type",
         "num_nodes",
         "ping",
+        "publish_epoch",
         "random_walk",
         "sage_minibatch",
         "sample_edge",
@@ -495,6 +507,8 @@ class GraphService:
         "sample_node_with_condition",
         "stats",
         "unit_edge_weights",
+        "upsert_edges",
+        "upsert_nodes",
     })
 
     def is_coordinator(self, op: str) -> bool:
@@ -518,11 +532,25 @@ class GraphService:
             # caches: bump it on any mutation and every client flushes on
             # its next observation. Old clients ignore the field; old
             # SERVERS omit it, which clients read as 0 = cache-forever.
+            delta = self._delta
             return [json.dumps({
                 "shard": self.shard,
                 "op_counts": dict(self.op_counts),
                 "graph_epoch": int(getattr(s, "graph_epoch", 0)),
+                # staged-but-unpublished writes (the delta overlay);
+                # readers never see them, operators want to
+                "delta_pending": (
+                    0 if delta is None else delta.pending()["rows"]
+                ),
             })]
+        if op == "upsert_nodes":
+            return self._stage_mutation(a[0], "nodes", a[1:])
+        if op == "upsert_edges":
+            return self._stage_mutation(a[0], "edges", a[1:])
+        if op == "delete_edges":
+            return self._stage_mutation(a[0], "edge_dels", a[1:])
+        if op == "publish_epoch":
+            return self._publish_epoch(a[0] if a else None)
         if op == "num_nodes":
             return [int(s.num_nodes)]
         if op == "ids_by_rows":
@@ -689,6 +717,100 @@ class GraphService:
         raise RuntimeError(
             f"op {op!r} is in HANDLED_VERBS but has no dispatch arm"
         )
+
+    # -- streaming mutation (graph/delta.py) -----------------------------
+
+    # bounded idempotency window: far wider than any writer's in-flight
+    # batch count, evicted FIFO so it can never grow without bound
+    APPLIED_KEYS_MAX = 4096
+    # a publish whose stale set is bigger than this answers retries with
+    # rows=None (full-invalidate) instead of caching huge arrays
+    PUBLISH_RESULT_CAP = 65536
+
+    def _stage_mutation(self, key, kind: str, args: list) -> list:
+        """Stage one writer batch into the shard's delta overlay.
+
+        [n_staged, applied] — applied=False means the idempotency key
+        was already seen (the writer's transport retry of a batch whose
+        response got lost): the batch is NOT re-staged, so a retried
+        upsert never double-applies. Overflow past the delta's row bound
+        raises the typed OverloadError (never transport-retried)."""
+        key = str(key)
+        with self._delta_lock:
+            hit = self._applied.get(key)
+            if hit is not None:
+                return [0, False]
+            delta = self._delta
+            if delta is None:
+                from euler_tpu.graph.delta import DeltaStore
+
+                delta = self._delta = DeltaStore(
+                    self.shard, self.meta.num_partitions
+                )
+            if kind == "nodes":
+                n = delta.stage_nodes(
+                    args[0], args[1], args[2], args[3] or [], args[4]
+                )
+            elif kind == "edges":
+                n = delta.stage_edges(*args[:8])
+            else:
+                n = delta.stage_edge_deletes(*args[:6])
+            self._applied[key] = True
+            while len(self._applied) > self.APPLIED_KEYS_MAX:
+                self._applied.popitem(last=False)
+        return [n, True]
+
+    def _publish_epoch(self, key) -> list:
+        """Merge the staged delta at an epoch boundary and swap
+        self.store in one reference assignment (readers bind the store
+        once per request — no torn snapshot, in-flight reads finish on
+        the old immutable arrays). Returns
+        [epoch, mutated_local_rows|None, touched_ids|None, num_nodes];
+        None row/id sets tell the client to fully flush its cache (used
+        for oversized stale sets and for retried publishes whose first
+        response was lost)."""
+        with self._delta_lock:
+            if key is not None:
+                hit = self._applied.get(f"pub:{key}")
+                if hit is not None:
+                    # retried publish: the merge already happened; replay
+                    # the recorded outcome instead of merging again
+                    return list(hit)
+            delta, self._delta = self._delta, None
+            store = self.store
+            if delta is None or delta.empty:
+                result = [
+                    int(getattr(store, "graph_epoch", 0)),
+                    np.empty(0, np.int64),
+                    np.empty(0, np.uint64),
+                    int(store.num_nodes),
+                ]
+            else:
+                new_store, rows, ids = store.merge_delta(delta)
+                self.store = new_store
+                # the cluster facade binds the old store object; patch it
+                # so coordinator ops (exec_plan/sample_fanout) serve the
+                # new epoch too
+                with self._cluster_lock:
+                    g = self._cluster_g
+                    if g is not None:
+                        for i, sh in enumerate(g.shards):
+                            if sh is store:
+                                g.shards[i] = self.store
+                        g.refresh_shard_weights()
+                if len(rows) + len(ids) > self.PUBLISH_RESULT_CAP:
+                    rows = ids = None  # client falls back to a full flush
+                result = [
+                    int(self.store.graph_epoch),
+                    rows,
+                    ids,
+                    int(self.store.num_nodes),
+                ]
+            if key is not None:
+                self._applied[f"pub:{key}"] = tuple(result)
+                while len(self._applied) > self.APPLIED_KEYS_MAX:
+                    self._applied.popitem(last=False)
+        return result
 
     def _sage_minibatch(
         self, batch_size, edge_types, counts, label, node_type, seed, lean

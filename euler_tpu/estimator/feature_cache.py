@@ -218,6 +218,33 @@ class ResidualFetchRing:
                     break  # ring full: the next poll re-schedules
         return bumped
 
+    def on_publish(self, result) -> bool:
+        """Eager half of the epoch handshake when the WRITER lives in
+        this process: feed `GraphWriter.publish()`'s dict straight in.
+        The publish's mutated global rows are scheduled for residual
+        refresh (whole table when the publish could not name them), and
+        the per-shard epoch book syncs to the published epochs so the
+        next `poll_epoch()` doesn't schedule the same refresh twice.
+        Remote-only readers keep using `poll_epoch()` — this is the
+        zero-latency path for the process that did the publishing.
+        Returns True when a refresh was scheduled."""
+        rows = result.get("rows") if isinstance(result, dict) else result
+        if isinstance(result, dict):
+            for part, ep in (result.get("epochs") or {}).items():
+                with self._lock:
+                    self._epochs[int(part)] = int(ep)
+        rows = np.asarray(
+            np.arange(self.cache.table.shape[0] - 1) if rows is None
+            else rows,
+            dtype=np.int64,
+        )
+        scheduled = False
+        for lo in range(0, len(rows), 65536):
+            if not self.prefetch(rows[lo : lo + 65536]):
+                break  # ring full: poll_epoch/commit cadence catches up
+            scheduled = True
+        return scheduled
+
     # -- worker / consumer side ------------------------------------------
 
     def _work(self):

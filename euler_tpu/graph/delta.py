@@ -1,0 +1,813 @@
+"""Streaming graph mutation: per-shard delta buffers + epoch publish.
+
+Euler 2.0's `GraphBuilder` supports a graph that is built and REBUILT
+while trainers read it; this module is that write path for the TPU
+build's columnar shards. The shape is write-ahead + epoch publish:
+
+- `DeltaStore` is a per-shard append-only buffer of typed mutation
+  batches (`upsert_nodes` / `upsert_edges` / `delete_nodes` /
+  `delete_edges`), mirroring the builder's partition-array schema
+  (graph/builder.py). Staged writes are INVISIBLE to readers — the base
+  `GraphStore` arrays are never touched, so every read keeps serving the
+  epoch-consistent base snapshot while a writer streams batches in.
+- `merge_arrays` folds a DeltaStore into a shard's arrays at an epoch
+  boundary, rebuilding only the TOUCHED structures: patched feature /
+  node rows, spliced CSR rows of mutated sources, remapped edge ids.
+  Untouched arrays are carried by reference (copy-on-first-write), so a
+  small delta costs O(touched + per-type indptr), not a partition
+  rebuild. The output is BIT-IDENTICAL to building the mutated graph
+  from scratch (builder.py on the post-mutation JSON) — the property the
+  tier-1 parity tests pin, and what keeps every execution lane (host,
+  fused, cached, device dense, device paged) consistent per epoch.
+- `GraphStore.merge_delta` (store.py) wraps the merge in the publish
+  discipline: new arrays become a NEW store object with `graph_epoch`
+  bumped, so serving processes swap one reference and in-flight reads
+  finish on the old immutable snapshot — no torn reads by construction
+  (the same immutable-engine swap the serving hot reload uses).
+
+Mutation semantics (the from-scratch reference is "apply the same edit
+to graph.json, rebuild"):
+
+- upsert_nodes: existing id → type/weight replaced, provided dense
+  features replaced (others kept); new id → inserted in sorted order
+  with zero features for anything not provided. Sparse/binary feature
+  mutation is not supported (raise) — their schemas are build-time.
+- upsert_edges: existing (src, dst, type) → weight replaced in place
+  (flat row and CSR slots keep their positions); new key → appended to
+  the flat edge arrays and spliced onto the END of its source row's CSR
+  segment, exactly where a from-scratch build of "record appended to
+  the JSON" puts it. Edge features of streamed edges are empty.
+- delete_edges: the flat edge row is removed (every CSR `eidx` is
+  remapped) and the adjacency/in-adjacency slots drop.
+- delete_nodes: the node row is removed (features and CSR rows go with
+  it); edge RECORDS referencing it survive in the flat arrays but drop
+  out of the adjacency, which is precisely what the builder emits for a
+  JSON with the node record gone.
+
+Bounds: a DeltaStore refuses rows past `EULER_TPU_DELTA_MAX_ROWS`
+(default 2_000_000) with a typed `OverloadError` — the wire maps it to
+the standard admission-control verdict, which clients never retry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+import numpy as np
+
+from euler_tpu.distributed.errors import OverloadError
+from euler_tpu.graph.meta import DENSE, GraphMeta
+
+
+def delta_max_rows() -> int:
+    return int(os.environ.get("EULER_TPU_DELTA_MAX_ROWS", 2_000_000))
+
+
+def _u64(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.uint64).reshape(-1)
+
+
+def _i32(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.int32).reshape(-1)
+
+
+def _f32(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.float32).reshape(-1)
+
+
+@dataclasses.dataclass
+class _NodeBatch:
+    ids: np.ndarray  # u64
+    types: np.ndarray  # i32
+    weights: np.ndarray  # f32
+    names: list  # dense feature names carried by this batch
+    dense: np.ndarray | None  # f32 [n, sum(dims of names)]
+
+
+@dataclasses.dataclass
+class _EdgeBatch:
+    # out-edges (this shard owns src) and in-edges (this shard owns dst)
+    osrc: np.ndarray
+    odst: np.ndarray
+    ott: np.ndarray
+    ow: np.ndarray
+    isrc: np.ndarray
+    idst: np.ndarray
+    itt: np.ndarray
+    iw: np.ndarray
+
+
+@dataclasses.dataclass
+class _EdgeDeleteBatch:
+    osrc: np.ndarray
+    odst: np.ndarray
+    ott: np.ndarray
+    isrc: np.ndarray
+    idst: np.ndarray
+    itt: np.ndarray
+
+
+@dataclasses.dataclass
+class _NodeDeleteBatch:
+    ids: np.ndarray
+
+
+class DeltaStore:
+    """Per-shard append-only mutation buffer (pre-routed to this shard).
+
+    Thread-safe: every buffer append happens under `self._lock` (server
+    worker threads stage concurrently), and the byte/row bound is
+    enforced there too — overflow raises a typed `OverloadError` BEFORE
+    buffering, so a rejected batch leaves no partial state behind.
+    Readers never see staged content: the overlay is append-only and
+    only `merge_arrays` (at publish) folds it into a NEW array set.
+    """
+
+    def __init__(self, part: int, num_partitions: int, max_rows: int | None = None):
+        self.part = int(part)
+        self.num_partitions = int(num_partitions)
+        self.max_rows = int(max_rows) if max_rows is not None else delta_max_rows()
+        self._lock = threading.Lock()
+        self._nodes: list[_NodeBatch] = []
+        self._edges: list[_EdgeBatch] = []
+        self._edge_dels: list[_EdgeDeleteBatch] = []
+        self._node_dels: list[_NodeDeleteBatch] = []
+        self._rows = 0
+
+    # -- staging ---------------------------------------------------------
+
+    def _admit(self, n: int) -> None:
+        # caller holds self._lock (every stage_* method takes it before
+        # calling here — the write below is never lock-free)
+        if self._rows + n > self.max_rows:
+            raise OverloadError(
+                f"delta buffer full on shard {self.part} "
+                f"({self._rows} staged + {n} > EULER_TPU_DELTA_MAX_ROWS="
+                f"{self.max_rows}); publish the pending epoch first"
+            )
+        self._rows += n  # graftlint: disable=lock-mixed-write -- every stage_* caller holds self._lock around this call
+
+    def stage_nodes(self, ids, types, weights, names=(), dense=None) -> int:
+        ids = _u64(ids)
+        types = _i32(types)
+        weights = _f32(weights)
+        names = list(names or ())
+        if not (len(ids) == len(types) == len(weights)):
+            raise ValueError("upsert_nodes: ids/types/weights length mismatch")
+        if names:
+            dense = np.asarray(dense, np.float32).reshape(len(ids), -1)
+        else:
+            dense = None
+        with self._lock:
+            self._admit(len(ids))
+            self._nodes.append(_NodeBatch(ids, types, weights, names, dense))
+        return len(ids)
+
+    def stage_edges(self, osrc, odst, ott, ow, isrc, idst, itt, iw) -> int:
+        b = _EdgeBatch(
+            _u64(osrc), _u64(odst), _i32(ott), _f32(ow),
+            _u64(isrc), _u64(idst), _i32(itt), _f32(iw),
+        )
+        if not (len(b.osrc) == len(b.odst) == len(b.ott) == len(b.ow)):
+            raise ValueError("upsert_edges: out column length mismatch")
+        if not (len(b.isrc) == len(b.idst) == len(b.itt) == len(b.iw)):
+            raise ValueError("upsert_edges: in column length mismatch")
+        n = len(b.osrc) + len(b.isrc)
+        with self._lock:
+            self._admit(n)
+            self._edges.append(b)
+        return n
+
+    def stage_edge_deletes(self, osrc, odst, ott, isrc, idst, itt) -> int:
+        b = _EdgeDeleteBatch(
+            _u64(osrc), _u64(odst), _i32(ott),
+            _u64(isrc), _u64(idst), _i32(itt),
+        )
+        n = len(b.osrc) + len(b.isrc)
+        with self._lock:
+            self._admit(n)
+            self._edge_dels.append(b)
+        return n
+
+    def stage_node_deletes(self, ids) -> int:
+        ids = _u64(ids)
+        with self._lock:
+            self._admit(len(ids))
+            self._node_dels.append(_NodeDeleteBatch(ids))
+        return len(ids)
+
+    # -- introspection (the read-overlay view) ---------------------------
+
+    @property
+    def empty(self) -> bool:
+        with self._lock:
+            return self._rows == 0
+
+    def pending(self) -> dict:
+        """Staged-row counts by kind — the diagnostic overlay view
+        (readers of the STORE never see these rows; they exist only
+        here until publish)."""
+        with self._lock:
+            return {
+                "rows": self._rows,
+                "node_upserts": sum(len(b.ids) for b in self._nodes),
+                "edge_upserts": sum(
+                    len(b.osrc) + len(b.isrc) for b in self._edges
+                ),
+                "edge_deletes": sum(
+                    len(b.osrc) + len(b.isrc) for b in self._edge_dels
+                ),
+                "node_deletes": sum(len(b.ids) for b in self._node_dels),
+                "max_rows": self.max_rows,
+            }
+
+    def snapshot(self) -> "DeltaStore":
+        """Detach the staged batches for merging: returns a frozen copy
+        holding the current buffers and resets this store to empty, all
+        under the lock — a concurrent stage lands either wholly before
+        the publish (merged now) or wholly after (next epoch)."""
+        with self._lock:
+            out = DeltaStore(self.part, self.num_partitions, self.max_rows)
+            out._nodes = self._nodes
+            out._edges = self._edges
+            out._edge_dels = self._edge_dels
+            out._node_dels = self._node_dels
+            out._rows = self._rows
+            self._nodes = []
+            self._edges = []
+            self._edge_dels = []
+            self._node_dels = []
+            self._rows = 0
+        return out
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+
+def _segment_arange(counts: np.ndarray) -> np.ndarray:
+    counts = np.asarray(counts, np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, np.int64)
+    ends = np.cumsum(counts)
+    return np.arange(total) - np.repeat(ends - counts, counts)
+
+
+def _dedupe_triples(src, dst, tt, vals=None):
+    """Unique (src, dst, type) keys keeping FIRST position and LAST
+    value — the stream semantics of re-upserting the same edge: its
+    JSON record is appended once (first occurrence) and then updated in
+    place. Returns (src, dst, tt[, vals]) ordered by first occurrence."""
+    if len(src) == 0:
+        return (src, dst, tt) + ((vals,) if vals is not None else ())
+    trip = np.stack([src, dst, tt.astype(np.uint64)], axis=1)
+    _, first_idx = np.unique(trip, axis=0, return_index=True)
+    order = np.sort(first_idx)
+    if vals is None:
+        return src[order], dst[order], tt[order]
+    _, last_rev = np.unique(trip[::-1], axis=0, return_index=True)
+    last_idx = len(trip) - 1 - last_rev
+    # np.unique sorts rows the same way for both passes, so last_idx[k]
+    # is the last occurrence of the SAME key first_idx[k] found
+    by_first = np.argsort(first_idx, kind="stable")
+    return (
+        src[first_idx[by_first]],
+        dst[first_idx[by_first]],
+        tt[first_idx[by_first]],
+        vals[last_idx[by_first]],
+    )
+
+
+class _Merge:
+    """One merge pass: delta folded into a copy-on-write array dict."""
+
+    def __init__(self, meta: GraphMeta, arrays: dict, part: int):
+        self.meta = meta
+        self.part = part
+        self.A = {k: np.asarray(v) for k, v in arrays.items()}
+        self._written: set[str] = set()
+        self.mutated: list[np.ndarray] = []  # local rows, NEW space
+        self.touched_ids: list[np.ndarray] = []
+        self.shift_start: int | None = None  # first row whose identity shifted
+
+    def W(self, key: str) -> np.ndarray:
+        """Copy-on-first-write: the base store's arrays are live read
+        snapshots and must never be mutated in place."""
+        if key not in self._written:
+            self.A[key] = np.array(self.A[key], copy=True)
+            self._written.add(key)
+        return self.A[key]
+
+    def put(self, key: str, arr: np.ndarray) -> None:
+        self.A[key] = arr
+        self._written.add(key)
+
+    def _tmp_store(self):
+        from euler_tpu.graph.store import GraphStore
+
+        return GraphStore(self.meta, self.A, self.part)
+
+    def _note_shift(self, row: int) -> None:
+        self.shift_start = (
+            int(row)
+            if self.shift_start is None
+            else min(self.shift_start, int(row))
+        )
+
+    # -- phase 1: node upserts -------------------------------------------
+
+    def node_upserts(self, batches: list[_NodeBatch]) -> None:
+        if not batches:
+            return
+        all_ids = np.unique(np.concatenate([b.ids for b in batches]))
+        for b in batches:
+            bad = (b.types < 0) | (b.types >= self.meta.num_node_types)
+            if bad.any():
+                raise ValueError(
+                    f"upsert_nodes: type out of range (num_node_types="
+                    f"{self.meta.num_node_types}) — type schemas are "
+                    "build-time, stream within them"
+                )
+        node_ids = self.A["node_ids"]
+        if len(node_ids):
+            pos = np.minimum(
+                np.searchsorted(node_ids, all_ids), len(node_ids) - 1
+            )
+            exists = node_ids[pos] == all_ids
+        else:
+            exists = np.zeros(len(all_ids), bool)
+        new_ids = all_ids[~exists]
+        if len(new_ids):
+            ins = np.searchsorted(node_ids, new_ids)
+            self.put("node_ids", np.insert(node_ids, ins, new_ids))
+            self.put(
+                "node_types",
+                np.insert(self.A["node_types"], ins, 0).astype(np.int32),
+            )
+            self.put(
+                "node_weights",
+                np.insert(self.A["node_weights"], ins, 0.0).astype(
+                    np.float32
+                ),
+            )
+            for t in range(self.meta.num_edge_types):
+                for tag in ("adj", "inadj"):
+                    k = f"{tag}_{t}_indptr"
+                    if k in self.A:
+                        ip = self.A[k]
+                        self.put(k, np.insert(ip, ins, ip[ins]))
+            for spec in self.meta.node_features.values():
+                if spec.kind == DENSE:
+                    k = f"nf_dense_{spec.fid}"
+                    self.put(k, np.insert(self.A[k], ins, 0.0, axis=0))
+                else:
+                    prefix = "sparse" if spec.kind == "sparse" else "bin"
+                    k = f"nf_{prefix}_{spec.fid}_indptr"
+                    ip = self.A[k]
+                    self.put(k, np.insert(ip, ins, ip[ins]))
+            self._note_shift(int(ins[0]))
+        # replay batches in order (later batches win) as pure row patches
+        tmp = self._tmp_store()
+        for b in batches:
+            rows = tmp.lookup(b.ids)
+            if (rows < 0).any():  # cannot happen after the insert above
+                raise RuntimeError("node upsert rows unresolved post-insert")
+            self.W("node_types")[rows] = b.types
+            self.W("node_weights")[rows] = b.weights
+            off = 0
+            for nm in b.names:
+                spec = self.meta.feature_spec(nm, node=True)
+                if spec.kind != DENSE:
+                    raise ValueError(
+                        f"upsert_nodes: feature {nm!r} is {spec.kind}; only "
+                        "dense features are mutable over the stream"
+                    )
+                if b.dense is None or b.dense.shape[1] < off + spec.dim:
+                    raise ValueError(
+                        "upsert_nodes: dense block narrower than the "
+                        "declared names"
+                    )
+                self.W(f"nf_dense_{spec.fid}")[rows] = b.dense[
+                    :, off : off + spec.dim
+                ]
+                off += spec.dim
+            self.mutated.append(np.asarray(rows, np.int64))
+            self.touched_ids.append(b.ids)
+
+    # -- phase 2: edge upserts -------------------------------------------
+
+    def edge_upserts(self, batches: list[_EdgeBatch]) -> dict:
+        """Returns {(src, dst, type): flat eidx} for edges appended here
+        (the in-adjacency phase needs it for locally-owned edges)."""
+        appended: dict = {}
+        if not batches:
+            return appended
+        osrc = np.concatenate([b.osrc for b in batches])
+        odst = np.concatenate([b.odst for b in batches])
+        ott = np.concatenate([b.ott for b in batches])
+        ow = np.concatenate([b.ow for b in batches])
+        bad = (ott < 0) | (ott >= self.meta.num_edge_types)
+        if len(ott) and bad.any():
+            raise ValueError(
+                f"upsert_edges: edge type out of range (num_edge_types="
+                f"{self.meta.num_edge_types})"
+            )
+        if len(osrc):
+            appended = self._edge_upserts_out(osrc, odst, ott, ow)
+        isrc = np.concatenate([b.isrc for b in batches])
+        idst = np.concatenate([b.idst for b in batches])
+        itt = np.concatenate([b.itt for b in batches])
+        iw = np.concatenate([b.iw for b in batches])
+        if len(isrc):
+            self._edge_upserts_in(isrc, idst, itt, iw, appended)
+        return appended
+
+    def _edge_upserts_out(self, src, dst, tt, w) -> dict:
+        src, dst, tt, w = _dedupe_triples(src, dst, tt, w)
+        tmp = self._tmp_store()
+        trip = np.stack([src, dst, tt.astype(np.uint64)], axis=1)
+        eidx = tmp._edge_rows(trip)
+        exist = eidx >= 0
+        # (a) weight replacement in place
+        if exist.any():
+            upd = eidx[exist]
+            ew = self.W("edge_weights")
+            ew[upd] = w[exist]
+            for t in np.unique(tt[exist]):
+                self._patch_csr_weights("adj", int(t), upd, ew)
+                self._patch_csr_weights("inadj", int(t), upd, ew)
+            rows = tmp.lookup(src[exist])
+            self.mutated.append(rows[rows >= 0].astype(np.int64))
+            self.touched_ids.append(src[exist])
+            self.touched_ids.append(dst[exist])
+        # (b) append the rest
+        ns, nd, nt, nw = src[~exist], dst[~exist], tt[~exist], w[~exist]
+        if not len(ns):
+            return {}
+        base_e = len(self.A["edge_src"])
+        self.put("edge_src", np.concatenate([self.A["edge_src"], ns]))
+        self.put("edge_dst", np.concatenate([self.A["edge_dst"], nd]))
+        self.put(
+            "edge_types",
+            np.concatenate([self.A["edge_types"], nt]).astype(np.int32),
+        )
+        self.put(
+            "edge_weights",
+            np.concatenate([self.A["edge_weights"], nw]).astype(np.float32),
+        )
+        for spec in self.meta.edge_features.values():
+            if spec.kind == DENSE:
+                k = f"ef_dense_{spec.fid}"
+                pad = np.zeros((len(ns), self.A[k].shape[1]), np.float32)
+                self.put(k, np.concatenate([self.A[k], pad], axis=0))
+            else:
+                prefix = "sparse" if spec.kind == "sparse" else "bin"
+                k = f"ef_{prefix}_{spec.fid}_indptr"
+                ip = self.A[k]
+                self.put(
+                    k,
+                    np.concatenate(
+                        [ip, np.full(len(ns), ip[-1], dtype=ip.dtype)]
+                    ),
+                )
+        new_eidx = base_e + np.arange(len(ns), dtype=np.int64)
+        appended = {
+            (int(s), int(d), int(t)): int(e)
+            for s, d, t, e in zip(ns, nd, nt, new_eidx)
+        }
+        rows = tmp.lookup(ns)
+        keep = rows >= 0  # non-resident src: flat arrays only (builder parity)
+        for t in np.unique(nt[keep]) if keep.any() else ():
+            sel = keep & (nt == t)
+            self._splice_csr(
+                "adj", int(t), rows[sel], nd[sel], nw[sel], new_eidx[sel]
+            )
+        self.mutated.append(rows[keep].astype(np.int64))
+        self.touched_ids.append(ns)
+        self.touched_ids.append(nd)
+        return appended
+
+    def _edge_upserts_in(self, src, dst, tt, w, appended: dict) -> None:
+        src, dst, tt, w = _dedupe_triples(src, dst, tt, w)
+        tmp = self._tmp_store()
+        rows = tmp.lookup(dst)
+        keep = rows >= 0
+        add_rows, add_src, add_w, add_eidx, add_tt = [], [], [], [], []
+        for s, d, t, wt, r, ok in zip(src, dst, tt, w, rows, keep):
+            if not ok:
+                continue
+            t = int(t)
+            k = f"inadj_{t}_indptr"
+            if k not in self.A:
+                continue
+            ip = self.A[k]
+            lo, hi = int(ip[r]), int(ip[r + 1])
+            seg = self.A[f"inadj_{t}_dst"][lo:hi]
+            hit = np.nonzero(seg == s)[0]
+            if len(hit):
+                self.W(f"inadj_{t}_w")[lo + int(hit[0])] = wt
+            else:
+                add_rows.append(int(r))
+                add_src.append(int(s))
+                add_w.append(float(wt))
+                add_tt.append(t)
+                # locally-owned edge rows carry their flat eidx; edges
+                # whose src lives on a peer shard stay -1 (builder parity)
+                add_eidx.append(appended.get((int(s), int(d), t), -1))
+        for t in sorted(set(add_tt)):
+            sel = [i for i, x in enumerate(add_tt) if x == t]
+            self._splice_csr(
+                "inadj",
+                t,
+                np.asarray([add_rows[i] for i in sel], np.int64),
+                np.asarray([add_src[i] for i in sel], np.uint64),
+                np.asarray([add_w[i] for i in sel], np.float32),
+                np.asarray([add_eidx[i] for i in sel], np.int64),
+            )
+        self.mutated.append(rows[keep].astype(np.int64))
+        self.touched_ids.append(dst)
+        self.touched_ids.append(src)
+
+    def _patch_csr_weights(self, tag: str, t: int, upd_eidx, ew) -> None:
+        k = f"{tag}_{t}_eidx"
+        if k not in self.A:
+            return
+        ce = self.A[k]
+        sel = (ce >= 0) & np.isin(ce, upd_eidx)
+        if sel.any():
+            self.W(f"{tag}_{t}_w")[sel] = ew[ce[sel]].astype(np.float32)
+
+    def _splice_csr(self, tag, t, rows, other, w, eidx) -> None:
+        """Append entries at the END of each row's segment (where the
+        builder's stable (type, row) lexsort puts late JSON records)."""
+        if not len(rows):
+            return
+        order = np.argsort(rows, kind="stable")
+        rows, other, w, eidx = rows[order], other[order], w[order], eidx[order]
+        ip = self.A[f"{tag}_{t}_indptr"]
+        n = len(ip) - 1
+        add_cnt = np.bincount(rows, minlength=n)
+        excl = np.concatenate([[0], np.cumsum(add_cnt)])
+        old_cnt = np.diff(ip)
+        new_ip = ip + excl
+        old_dst = self.A[f"{tag}_{t}_dst"]
+        old_w = self.A[f"{tag}_{t}_w"]
+        old_e = self.A[f"{tag}_{t}_eidx"]
+        nnz = len(old_dst)
+        dst2 = np.empty(nnz + len(rows), old_dst.dtype)
+        w2 = np.empty(nnz + len(rows), old_w.dtype)
+        e2 = np.empty(nnz + len(rows), old_e.dtype)
+        dest_old = np.arange(nnz) + np.repeat(excl[:-1], old_cnt)
+        dst2[dest_old] = old_dst
+        w2[dest_old] = old_w
+        e2[dest_old] = old_e
+        dest_new = np.repeat(
+            new_ip[:-1] + old_cnt, add_cnt
+        ) + _segment_arange(add_cnt)
+        dst2[dest_new] = other
+        w2[dest_new] = w
+        e2[dest_new] = eidx
+        self.put(f"{tag}_{t}_indptr", new_ip)
+        self.put(f"{tag}_{t}_dst", dst2)
+        self.put(f"{tag}_{t}_w", w2)
+        self.put(f"{tag}_{t}_eidx", e2)
+
+    # -- phase 3: edge deletes -------------------------------------------
+
+    def edge_deletes(self, batches: list[_EdgeDeleteBatch]) -> None:
+        if not batches:
+            return
+        osrc = np.concatenate([b.osrc for b in batches])
+        odst = np.concatenate([b.odst for b in batches])
+        ott = np.concatenate([b.ott for b in batches])
+        isrc = np.concatenate([b.isrc for b in batches])
+        idst = np.concatenate([b.idst for b in batches])
+        itt = np.concatenate([b.itt for b in batches])
+        tmp = self._tmp_store()
+        del_eidx = np.empty(0, np.int64)
+        if len(osrc):
+            osrc, odst, ott = _dedupe_triples(osrc, odst, ott)
+            trip = np.stack([osrc, odst, ott.astype(np.uint64)], axis=1)
+            eidx = tmp._edge_rows(trip)
+            del_eidx = np.unique(eidx[eidx >= 0])
+            rows = tmp.lookup(osrc)
+            self.mutated.append(rows[rows >= 0].astype(np.int64))
+            self.touched_ids.append(osrc)
+            self.touched_ids.append(odst)
+        e_total = len(self.A["edge_src"])
+        keep = np.ones(e_total, bool)
+        keep[del_eidx] = False
+        remap = np.cumsum(keep, dtype=np.int64) - 1
+        if len(del_eidx):
+            self.put("edge_src", self.A["edge_src"][keep])
+            self.put("edge_dst", self.A["edge_dst"][keep])
+            self.put("edge_types", self.A["edge_types"][keep])
+            self.put("edge_weights", self.A["edge_weights"][keep])
+            for spec in self.meta.edge_features.values():
+                if spec.kind == DENSE:
+                    k = f"ef_dense_{spec.fid}"
+                    self.put(k, self.A[k][keep])
+                else:
+                    prefix = "sparse" if spec.kind == "sparse" else "bin"
+                    kip = f"ef_{prefix}_{spec.fid}_indptr"
+                    kv = f"ef_{prefix}_{spec.fid}_values"
+                    ip = self.A[kip]
+                    lens = np.diff(ip)
+                    self.put(
+                        kip,
+                        np.concatenate(
+                            [[0], np.cumsum(lens[keep])]
+                        ).astype(ip.dtype),
+                    )
+                    self.put(kv, self.A[kv][np.repeat(keep, lens)])
+        # in-side matches for cross-shard deletes: (dst row, src, type)
+        in_hits: dict[int, list[int]] = {}
+        if len(isrc):
+            isrc, idst, itt = _dedupe_triples(isrc, idst, itt)
+            rows_d = tmp.lookup(idst)
+            for s, d, t, r in zip(isrc, idst, itt, rows_d):
+                if r < 0:
+                    continue
+                t = int(t)
+                k = f"inadj_{t}_indptr"
+                if k not in self.A:
+                    continue
+                ip = self.A[k]
+                lo, hi = int(ip[r]), int(ip[r + 1])
+                seg = self.A[f"inadj_{t}_dst"][lo:hi]
+                for off in np.nonzero(seg == s)[0]:
+                    in_hits.setdefault(t, []).append(lo + int(off))
+            self.mutated.append(rows_d[rows_d >= 0].astype(np.int64))
+            self.touched_ids.append(idst)
+            self.touched_ids.append(isrc)
+        if not len(del_eidx) and not in_hits:
+            return
+        for t in range(self.meta.num_edge_types):
+            self._drop_csr_entries(
+                "adj", t, del_eidx, remap, extra_positions=()
+            )
+            self._drop_csr_entries(
+                "inadj", t, del_eidx, remap,
+                extra_positions=in_hits.get(t, ()),
+            )
+
+    def _drop_csr_entries(self, tag, t, del_eidx, remap, extra_positions):
+        k = f"{tag}_{t}_indptr"
+        if k not in self.A:
+            return
+        ip = self.A[k]
+        ce = self.A[f"{tag}_{t}_eidx"]
+        drop = np.zeros(len(ce), bool)
+        if len(del_eidx):
+            drop |= (ce >= 0) & np.isin(ce, del_eidx)
+        if len(extra_positions):
+            drop[np.asarray(extra_positions, np.int64)] = True
+        if not drop.any() and not len(del_eidx):
+            return  # nothing dropped here and no eidx shift to remap
+        if drop.any():
+            rows_of = np.repeat(
+                np.arange(len(ip) - 1, dtype=np.int64), np.diff(ip)
+            )
+            kept_counts = np.bincount(
+                rows_of[~drop], minlength=len(ip) - 1
+            )
+            self.put(
+                k, np.concatenate([[0], np.cumsum(kept_counts)]).astype(
+                    ip.dtype
+                )
+            )
+            self.put(f"{tag}_{t}_dst", self.A[f"{tag}_{t}_dst"][~drop])
+            self.put(f"{tag}_{t}_w", self.A[f"{tag}_{t}_w"][~drop])
+            ce = ce[~drop]
+        new_e = np.where(ce >= 0, remap[np.maximum(ce, 0)], -1)
+        self.put(f"{tag}_{t}_eidx", new_e.astype(np.int64))
+
+    # -- phase 4: node deletes -------------------------------------------
+
+    def node_deletes(self, batches: list[_NodeDeleteBatch]) -> None:
+        if not batches:
+            return
+        ids = np.unique(np.concatenate([b.ids for b in batches]))
+        tmp = self._tmp_store()
+        rows = tmp.lookup(ids)
+        drop_rows = np.sort(rows[rows >= 0]).astype(np.int64)
+        if not len(drop_rows):
+            return
+        n = len(self.A["node_ids"])
+        keep = np.ones(n, bool)
+        keep[drop_rows] = False
+        self.put("node_ids", self.A["node_ids"][keep])
+        self.put("node_types", self.A["node_types"][keep])
+        self.put("node_weights", self.A["node_weights"][keep])
+        for spec in self.meta.node_features.values():
+            if spec.kind == DENSE:
+                k = f"nf_dense_{spec.fid}"
+                self.put(k, self.A[k][keep])
+            else:
+                prefix = "sparse" if spec.kind == "sparse" else "bin"
+                kip = f"nf_{prefix}_{spec.fid}_indptr"
+                kv = f"nf_{prefix}_{spec.fid}_values"
+                ip = self.A[kip]
+                lens = np.diff(ip)
+                self.put(
+                    kip,
+                    np.concatenate([[0], np.cumsum(lens[keep])]).astype(
+                        ip.dtype
+                    ),
+                )
+                self.put(kv, self.A[kv][np.repeat(keep, lens)])
+        for t in range(self.meta.num_edge_types):
+            for tag in ("adj", "inadj"):
+                k = f"{tag}_{t}_indptr"
+                if k not in self.A:
+                    continue
+                ip = self.A[k]
+                entry_keep = np.repeat(keep, np.diff(ip))
+                self.put(
+                    k,
+                    np.concatenate(
+                        [[0], np.cumsum(np.diff(ip)[keep])]
+                    ).astype(ip.dtype),
+                )
+                self.put(f"{tag}_{t}_dst", self.A[f"{tag}_{t}_dst"][entry_keep])
+                self.put(f"{tag}_{t}_w", self.A[f"{tag}_{t}_w"][entry_keep])
+                self.put(
+                    f"{tag}_{t}_eidx", self.A[f"{tag}_{t}_eidx"][entry_keep]
+                )
+        # graph-label groups reference node IDS: a deleted node's record
+        # (and with it its graph_label feature) is gone from-scratch, so
+        # its id drops out of the label grouping too
+        gn = self.A.get("glabel_nodes")
+        if gn is not None and len(gn):
+            gkeep = ~np.isin(gn, ids)
+            if not gkeep.all():
+                gip = self.A["glabel_indptr"]
+                lens = np.diff(gip)
+                rows_of = np.repeat(np.arange(len(lens)), lens)
+                self.put(
+                    "glabel_indptr",
+                    np.concatenate(
+                        [[0], np.cumsum(
+                            np.bincount(rows_of[gkeep], minlength=len(lens))
+                        )]
+                    ).astype(gip.dtype),
+                )
+                self.put("glabel_nodes", gn[gkeep])
+        self._note_shift(int(drop_rows[0]))
+        self.touched_ids.append(ids)
+
+    # -- finish ----------------------------------------------------------
+
+    def finish(self) -> tuple[dict, np.ndarray, np.ndarray]:
+        nt = np.asarray(self.A["node_types"])
+        nw = np.zeros(self.meta.num_node_types, np.float64)
+        if len(nt):
+            np.add.at(
+                nw, nt, np.asarray(self.A["node_weights"], np.float64)
+            )
+        et = np.asarray(self.A["edge_types"])
+        ew = np.zeros(self.meta.num_edge_types, np.float64)
+        if len(et):
+            np.add.at(
+                ew, et, np.asarray(self.A["edge_weights"], np.float64)
+            )
+        self.meta.node_weight_sums[self.part] = nw.tolist()
+        self.meta.edge_weight_sums[self.part] = ew.tolist()
+        n_new = len(self.A["node_ids"])
+        parts = [
+            m[(m >= 0) & (m < n_new)] for m in self.mutated if len(m)
+        ]
+        if self.shift_start is not None:
+            parts.append(np.arange(self.shift_start, n_new, dtype=np.int64))
+        rows = (
+            np.unique(np.concatenate(parts))
+            if parts
+            else np.empty(0, np.int64)
+        )
+        ids = (
+            np.unique(np.concatenate(self.touched_ids))
+            if self.touched_ids
+            else np.empty(0, np.uint64)
+        )
+        return self.A, rows.astype(np.int64), ids.astype(np.uint64)
+
+
+def merge_arrays(
+    meta: GraphMeta, arrays: dict, part: int, delta: DeltaStore
+) -> tuple[dict, np.ndarray, np.ndarray]:
+    """Fold `delta` into a COPY of `arrays` (untouched keys carried by
+    reference). Returns (new_arrays, mutated_local_rows, touched_ids):
+    rows are in the NEW row space and include every row whose identity
+    shifted through an insert/delete; ids are the node ids whose
+    blocks (features, neighborhoods, degrees) changed semantically —
+    exactly what a client read cache must drop on publish."""
+    m = _Merge(meta, arrays, part)
+    m.node_upserts(delta._nodes)
+    m.edge_upserts(delta._edges)
+    m.edge_deletes(delta._edge_dels)
+    m.node_deletes(delta._node_dels)
+    return m.finish()

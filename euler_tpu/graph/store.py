@@ -21,6 +21,7 @@ feed straight into jitted XLA programs.
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -348,14 +349,49 @@ class GraphStore:
         self._unit_w: dict[int, bool] = {}  # per-type all-weights-==-1.0
         # data version served over the wire (`stats.graph_epoch`): any
         # in-place mutation of this shard's arrays must bump_epoch() so
-        # client read caches invalidate instead of serving stale bytes
+        # client read caches invalidate instead of serving stale bytes.
+        # Guarded by _lock: epoch writes race with concurrent merges and
+        # server stat reads, and the delta-merge path publishes through it.
+        self._lock = threading.Lock()
         self.graph_epoch = 0
 
     def bump_epoch(self) -> int:
         """Advance the shard's data version after an in-place mutation;
         remote read caches flush on the next epoch observation."""
-        self.graph_epoch += 1
-        return self.graph_epoch
+        with self._lock:
+            self.graph_epoch += 1
+            return self.graph_epoch
+
+    def merge_delta(self, delta):
+        """Publish a DeltaStore at an epoch boundary.
+
+        Folds the staged mutations into this shard's arrays — rebuilding
+        only the touched CSR rows / feature rows (untouched arrays are
+        carried by reference) — and returns ``(new_store, rows, ids)``:
+        a NEW GraphStore over the merged arrays with ``graph_epoch``
+        bumped, the mutated LOCAL rows (new row space, including every
+        row whose index shifted through an insert/delete), and the node
+        ids whose cached blocks went stale. The receiving process swaps
+        its store reference in one assignment, so in-flight reads finish
+        on this (immutable) snapshot and hot-path readers can never see
+        a torn mix of epochs — the same swap discipline as the serving
+        hot reload. Samplers, edge-key indexes, and attribute indexes
+        rebuild lazily on the new store (the "sampler alias" rebuild is
+        confined to the merged shard).
+
+        Bit-parity contract: the merged arrays equal a from-scratch
+        ``build_from_json`` of the equivalently mutated graph.json —
+        pinned by tests/test_delta.py.
+        """
+        from euler_tpu.graph.delta import merge_arrays
+
+        with self._lock:
+            new_arrays, rows, ids = merge_arrays(
+                self.meta, self.arrays, self.part, delta
+            )
+            new_store = GraphStore(self.meta, new_arrays, self.part)
+            new_store.graph_epoch = self.graph_epoch + 1
+        return new_store, rows, ids
 
     # ---- id resolution -------------------------------------------------
 
@@ -377,12 +413,15 @@ class GraphStore:
             raise IndexError(f"node type {key} out of range")
         s = self._samplers_n.get(key)
         if s is None:
+            # build outside the lock (masked-weight copy can be big),
+            # publish under it — racing builders agree via setdefault
             w = (
                 self.node_weights
                 if key < 0
                 else np.where(self.node_types == key, self.node_weights, 0.0)
             )
-            s = self._samplers_n[key] = _WeightedSampler(w)
+            with self._lock:
+                s = self._samplers_n.setdefault(key, _WeightedSampler(w))
         return s
 
     def _edge_sampler(self, edge_type: int) -> _WeightedSampler:
@@ -396,7 +435,8 @@ class GraphStore:
                 if key < 0
                 else np.where(self.edge_types == key, self.edge_weights, 0.0)
             )
-            s = self._samplers_e[key] = _WeightedSampler(w)
+            with self._lock:
+                s = self._samplers_e.setdefault(key, _WeightedSampler(w))
         return s
 
     def unit_edge_weights(self, edge_types=None) -> bool:
@@ -413,6 +453,7 @@ class GraphStore:
         for t in types:
             key = int(t)
             if key not in self._unit_w:
+                # scan outside the lock (mmap stream), publish under it
                 ok = True
                 if key < len(self.adj):
                     w = self.adj[key].w
@@ -420,7 +461,8 @@ class GraphStore:
                         if not np.all(w[lo : lo + (1 << 22)] == 1.0):
                             ok = False
                             break
-                self._unit_w[key] = ok
+                with self._lock:
+                    self._unit_w.setdefault(key, ok)
             if not self._unit_w[key]:
                 return False
         return True
@@ -469,9 +511,12 @@ class GraphStore:
 
     def _csrs(self, edge_types, in_edges: bool = False) -> list[_CSR]:
         table = self.inadj if in_edges else self.adj
-        if edge_types is None:
-            edge_types = range(self.meta.num_edge_types)
-        return [(t, table[t]) for t in edge_types]
+        types = (
+            range(self.meta.num_edge_types)
+            if edge_types is None
+            else edge_types
+        )
+        return [(t, table[t]) for t in types]
 
     def sample_neighbor(
         self, ids, edge_types=None, count: int = 10, rng=None, in_edges=False
@@ -729,15 +774,20 @@ class GraphStore:
         parallel duplicate triples resolve to one of their rows).
         """
         if self._edge_key_index is None:
+            # O(E log E) sort outside the lock; publish under it with a
+            # re-check so racing builders keep exactly one index
             order = np.lexsort(
                 (self.edge_types, self.edge_dst, self.edge_src)
             ).astype(np.int64)
-            self._edge_key_index = (
+            built = (
                 order,
                 np.ascontiguousarray(self.edge_src[order]),
                 np.ascontiguousarray(self.edge_dst[order]),
                 np.ascontiguousarray(self.edge_types[order]),
             )
+            with self._lock:
+                if self._edge_key_index is None:
+                    self._edge_key_index = built
         order, s_src, s_dst, s_typ = self._edge_key_index
         q = np.asarray(edge_ids, dtype=np.uint64).reshape(-1, 3)
         if len(order) == 0:  # edge-less shard: nothing can match
@@ -791,7 +841,10 @@ class GraphStore:
         if self._index_mgr is None:
             from euler_tpu.graph.index import IndexManager
 
-            self._index_mgr = IndexManager(self, node=True)
+            built = IndexManager(self, node=True)
+            with self._lock:
+                if self._index_mgr is None:
+                    self._index_mgr = built
         return self._index_mgr
 
     @property
@@ -799,7 +852,10 @@ class GraphStore:
         if self._edge_index_mgr is None:
             from euler_tpu.graph.index import IndexManager
 
-            self._edge_index_mgr = IndexManager(self, node=False)
+            built = IndexManager(self, node=False)
+            with self._lock:
+                if self._edge_index_mgr is None:
+                    self._edge_index_mgr = built
         return self._edge_index_mgr
 
     def search_condition(self, dnf, node: bool = True):
@@ -970,6 +1026,18 @@ class Graph:
             )
         else:
             self._dispatch_pool = None
+
+    def refresh_shard_weights(self) -> None:
+        """Re-read the per-shard weight sums from the meta — the facade
+        copies them at construction, and a published delta merge updates
+        the meta's lists in place, so root-sampling shard weights must
+        re-sync after every publish (GraphWriter.publish calls this)."""
+        self._node_shard_w = np.asarray(
+            self.meta.node_weight_sums, dtype=np.float64
+        )
+        self._edge_shard_w = np.asarray(
+            self.meta.edge_weight_sums, dtype=np.float64
+        )
 
     # -- construction ----------------------------------------------------
 

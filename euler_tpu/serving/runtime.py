@@ -165,6 +165,28 @@ class InferenceRuntime:
         for b in self.buckets:
             self._predict_bucket(np.ones(b, np.uint64), b, eng)
 
+    def poll_graph_epoch(self) -> bool:
+        """Streaming-mutation handshake for the serving path: re-observe
+        each remote shard's graph_epoch (`refresh_epoch` flushes that
+        shard's ReadCache on a bump), so predictions served after a
+        publish read the new epoch instead of cached pre-publish bytes.
+        Local in-process graphs swap their store references at publish
+        and need no poll. Safe from any thread (the predict path holds
+        no state this touches); call it between batches or on a timer.
+        Returns True when any shard reported a new epoch."""
+        bumped = False
+        graph = getattr(self.flow, "graph", None)
+        for sh in getattr(graph, "shards", []) or []:
+            fn = getattr(sh, "refresh_epoch", None)
+            if fn is None:
+                continue
+            cache = getattr(sh, "_cache", None)
+            before = getattr(cache, "epoch", None)
+            after = int(fn())
+            if before is not None and after != before:
+                bumped = True
+        return bumped
+
     def swap(self, cfg=None, params=None, warm: bool = True) -> dict:
         """Zero-downtime checkpoint hot reload.
 

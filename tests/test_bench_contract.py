@@ -79,6 +79,17 @@ def test_bench_smoke_emits_final_json_line():
     assert row["paged_sample_edges_per_sec"] > 0
     assert row["dense_sample_edges_per_sec"] > 0
     assert row["paged_over_dense"] > 0
+    # the streaming-mutation lane (ISSUE 8) must not silently vanish:
+    # writer staging throughput, publish latency at both delta sizes,
+    # post-publish read recovery, and the merged == from-scratch parity
+    # oracle all ride the artifact
+    assert row["mutation"] is True, row
+    assert row["mutation_bit_parity"] is True, row
+    assert row["mutation_upserts_per_sec"] > 0
+    assert row["mutation_publish_ms_small"] > 0
+    assert row["mutation_publish_ms_large"] > 0
+    assert row["mutation_read_recovery_ms"] > 0
+    assert row["mutation_read_rate_post_over_pre"] > 0
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline

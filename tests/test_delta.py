@@ -1,0 +1,713 @@
+"""Streaming graph mutation (ISSUE 8): delta buffers, epoch publish,
+write-path wire verbs, and the online-mutation scenario.
+
+The contract under test: staged writes are invisible until publish;
+every published epoch is BIT-IDENTICAL to a from-scratch build of the
+mutated graph (host lane, device dense lane, device paged lane); the
+publish swap never shows a torn snapshot to concurrent readers; retried
+writer batches apply exactly once under PR-4 fault injection; and
+training + fleet serving keep running, epoch-consistently, while a
+seeded writer streams mutations in.
+"""
+
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.distributed import chaos
+from euler_tpu.distributed.cache import ReadCache
+from euler_tpu.distributed.chaos import Fault, FaultPlan
+from euler_tpu.distributed.errors import OverloadError
+from euler_tpu.distributed.writer import GraphWriter
+from euler_tpu.graph import Graph
+from euler_tpu.graph.builder import build_from_json, convert_json
+from euler_tpu.graph.delta import DeltaStore
+from euler_tpu.graph.store import GraphStore
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+# ---------------------------------------------------------------------------
+
+
+def _graph_dict(n=16, feat_dim=4, seed=0):
+    """Deterministic weighted digraph with dense feat + label features
+    and UNIQUE (src, dst, type) edge keys (upserts target one edge)."""
+    rng = np.random.default_rng(seed)
+    nodes = [
+        {
+            "id": i,
+            "type": i % 2,
+            "weight": float(1 + i % 3),
+            "features": [
+                {"name": "feat", "type": "dense",
+                 "value": rng.normal(size=feat_dim).tolist()},
+                {"name": "label", "type": "dense",
+                 "value": [1.0, 0.0] if i % 2 else [0.0, 1.0]},
+            ],
+        }
+        for i in range(1, n + 1)
+    ]
+    edges = [
+        {"src": s, "dst": (s + off) % n + 1, "type": off % 2,
+         "weight": float(1 + (s + off) % 4), "features": []}
+        for s in range(1, n + 1)
+        for off in (1, 3, 7)
+    ]
+    return {"nodes": nodes, "edges": edges}
+
+
+def _apply_json(data, muts):
+    """The from-scratch reference: apply mutations to the JSON dict."""
+    data = {
+        "nodes": [dict(x) for x in data["nodes"]],
+        "edges": [dict(x) for x in data["edges"]],
+    }
+    for m in muts:
+        kind = m[0]
+        if kind == "un":
+            _, nid, t, w, feats = m
+            rec = next((x for x in data["nodes"] if x["id"] == nid), None)
+            if rec is None:
+                rec = {"id": nid, "type": t, "weight": w, "features": []}
+                data["nodes"].append(rec)
+            rec["type"], rec["weight"] = t, w
+            fl = [dict(f) for f in rec.get("features", [])]
+            for name, vals in feats.items():
+                hit = next((f for f in fl if f["name"] == name), None)
+                if hit is None:
+                    fl.append(
+                        {"name": name, "type": "dense", "value": list(vals)}
+                    )
+                else:
+                    hit["value"] = list(vals)
+            rec["features"] = fl
+        elif kind == "ue":
+            _, s, d, t, w = m
+            rec = next(
+                (e for e in data["edges"]
+                 if e["src"] == s and e["dst"] == d and e["type"] == t),
+                None,
+            )
+            if rec is None:
+                data["edges"].append(
+                    {"src": s, "dst": d, "type": t, "weight": w,
+                     "features": []}
+                )
+            else:
+                rec["weight"] = w
+        elif kind == "de":
+            _, s, d, t = m
+            data["edges"] = [
+                e for e in data["edges"]
+                if not (e["src"] == s and e["dst"] == d and e["type"] == t)
+            ]
+        elif kind == "dn":
+            _, nid = m
+            data["nodes"] = [x for x in data["nodes"] if x["id"] != nid]
+    return data
+
+
+def _route(writer, muts):
+    """Feed the same mutations through the GraphWriter surface."""
+    for m in muts:
+        if m[0] == "un":
+            _, nid, t, w, feats = m
+            writer.upsert_nodes(
+                [nid], [t], [w],
+                dense={k: [v] for k, v in feats.items()} or None,
+            )
+        elif m[0] == "ue":
+            _, s, d, t, w = m
+            writer.upsert_edges([s], [d], [t], [w])
+        elif m[0] == "de":
+            _, s, d, t = m
+            writer.delete_edges([s], [d], [t])
+        elif m[0] == "dn":
+            writer.delete_nodes([m[1]])
+
+
+def _assert_arrays_equal(got: dict, want: dict, label=""):
+    assert set(got) == set(want), (label, set(got) ^ set(want))
+    for k in sorted(want):
+        assert np.array_equal(np.asarray(got[k]), np.asarray(want[k])), (
+            f"{label}: array {k!r} diverged from the from-scratch build"
+        )
+
+
+_CASES = {
+    "new_edge": [("ue", 1, 5, 0, 5.0)],
+    "weight_update": [("ue", 1, 3, 1, 9.0)],
+    "edge_delete": [("de", 3, 5, 1)],
+    "new_node": [("un", 99, 1, 2.5, {"feat": [9.0, 9.1, 9.2, 9.3]})],
+    "node_update": [("un", 2, 0, 7.0, {"feat": [1.0, 2.0, 3.0, 4.0]})],
+    "node_delete": [("dn", 5)],
+    "combined": [
+        ("un", 99, 1, 2.5, {"feat": [9.0, 9.1, 9.2, 9.3]}),
+        ("un", 100, 0, 1.0, {}),
+        ("ue", 99, 100, 0, 1.5),
+        ("ue", 1, 3, 1, 2.0),
+        ("ue", 2, 99, 1, 3.0),
+        ("de", 3, 5, 1),
+        ("un", 99, 1, 3.5, {"feat": [8.0, 8.1, 8.2, 8.3]}),
+        ("ue", 99, 100, 0, 2.5),
+        ("dn", 4),
+    ],
+}
+
+
+# ---------------------------------------------------------------------------
+# DeltaStore: bounds, overlay invisibility, snapshot
+# ---------------------------------------------------------------------------
+
+
+def test_delta_store_bound_overflows_typed():
+    d = DeltaStore(0, 1, max_rows=3)
+    d.stage_edges([1, 2], [3, 4], [0, 0], [1.0, 1.0], [], [], [], [])
+    with pytest.raises(OverloadError, match="EULER_TPU_DELTA_MAX_ROWS"):
+        d.stage_nodes([7, 8], [0, 0], [1.0, 1.0])
+    # the rejected batch left no partial state behind
+    assert d.pending()["rows"] == 2
+    assert d.pending()["node_upserts"] == 0
+
+
+def test_delta_overlay_invisible_until_publish():
+    g = Graph.from_json(_graph_dict(), num_partitions=1)
+    store = g.shards[0]
+    before = g.get_dense_feature([2], ["feat"]).copy()
+    w = GraphWriter(g)
+    w.upsert_nodes([2], [0], [1.0], dense={"feat": [[5, 5, 5, 5]]})
+    w.upsert_edges([1], [9], [0], [4.0])
+    w.flush()  # staged in the per-shard DeltaStore, NOT in the arrays
+    assert np.array_equal(g.get_dense_feature([2], ["feat"]), before)
+    assert store.graph_epoch == 0
+    w.publish()
+    assert np.allclose(g.get_dense_feature([2], ["feat"]), [[5, 5, 5, 5]])
+    assert g.shards[0].graph_epoch == 1
+    # the OLD store object still serves the pre-publish snapshot — the
+    # swap (not in-place mutation) is what makes reads torn-free
+    assert np.array_equal(store.get_dense_feature([2], ["feat"]), before)
+    assert store is not g.shards[0]
+
+
+def test_delta_snapshot_detaches_under_stagers():
+    d = DeltaStore(0, 1)
+    d.stage_edges([1], [2], [0], [1.0], [], [], [], [])
+    snap = d.snapshot()
+    assert snap.pending()["rows"] == 1 and d.pending()["rows"] == 0
+    d.stage_edges([3], [4], [0], [1.0], [], [], [], [])
+    assert snap.pending()["rows"] == 1  # later stages land in the NEW buffer
+
+
+# ---------------------------------------------------------------------------
+# merge bit-parity: merged == from-scratch build (the standing oracle)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("parts", [1, 2])
+@pytest.mark.parametrize("case", sorted(_CASES))
+def test_merge_bit_parity(parts, case):
+    muts = _CASES[case]
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=parts)
+    w = GraphWriter(g)
+    _route(w, muts)
+    res = w.publish()
+    ref_meta, ref_shards = build_from_json(_apply_json(base, muts), parts)
+    for p in range(parts):
+        _assert_arrays_equal(
+            g.shards[p].arrays, ref_shards[p], f"{case} P={parts} part{p}"
+        )
+        assert np.allclose(
+            g.meta.node_weight_sums[p], ref_meta.node_weight_sums[p]
+        )
+        assert np.allclose(
+            g.meta.edge_weight_sums[p], ref_meta.edge_weight_sums[p]
+        )
+    # shards that received staged rows bumped their epoch; untouched
+    # shards stay on their old (still-valid) snapshot
+    assert max(s.graph_epoch for s in g.shards) == 1
+    # rows/ids surfaces exist for downstream invalidation
+    assert res["rows"] is not None and res["ids"] is not None
+
+
+def test_merge_reports_mutated_rows_and_ids():
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=1)
+    store = g.shards[0]
+    d = DeltaStore(0, 1)
+    d.stage_nodes([2], [0], [3.0], ["feat"], np.full((1, 4), 7.0, np.float32))
+    d.stage_edges([1], [5], [0], [2.0], [1], [5], [0], [2.0])
+    new_store, rows, ids = store.merge_delta(d)
+    # row of node 2 mutated (feature), rows of 1 (out-edge) and 5 (in)
+    r = {int(new_store.lookup([i])[0]) for i in (1, 2, 5)}
+    assert r <= set(rows.tolist())
+    assert {1, 2, 5} <= set(ids.tolist())
+    assert new_store.graph_epoch == store.graph_epoch + 1
+
+
+# ---------------------------------------------------------------------------
+# epoch-race hammer: a bump between a reader's poll and its cached read
+# must flush on the NEXT read and never re-seed stale bytes
+# ---------------------------------------------------------------------------
+
+
+def test_readcache_epoch_race_hammer():
+    cache = ReadCache(budget_bytes=1 << 20)
+    server_epoch = [0]  # the "shard": value of every id == its epoch
+    stop = threading.Event()
+    errors: list = []
+
+    def fetch_fn(miss):
+        # simulate wire latency so fetches straddle epoch bumps
+        e = server_epoch[0]
+        time.sleep(0.0005)
+        return [np.full((len(miss), 2), e, np.float64)]
+
+    def reader(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                start_epoch = cache.epoch or 0
+                ids = rng.integers(0, 64, size=8).astype(np.uint64)
+                (vals,) = cache.fetch(("v",), ids, fetch_fn)
+                # nothing served may predate the epoch observed at
+                # fetch start — stale bytes under a new epoch are the
+                # cross-epoch mix this pins
+                if vals.min() < start_epoch:
+                    errors.append(
+                        f"stale value {vals.min()} under epoch {start_epoch}"
+                    )
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    threads = [
+        threading.Thread(target=reader, args=(i,), daemon=True)
+        for i in range(6)
+    ]
+    cache.observe_epoch(0)
+    for t in threads:
+        t.start()
+    for _ in range(30):  # bumper: the server mutates, readers poll
+        time.sleep(0.003)
+        server_epoch[0] += 1
+        cache.observe_epoch(server_epoch[0])
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors[:5]
+    # after the final flush a fresh fetch serves ONLY the final epoch —
+    # no stale block survived or was re-seeded post-flush
+    (final,) = cache.fetch(
+        ("v",), np.arange(64, dtype=np.uint64), fetch_fn
+    )
+    assert final.min() == server_epoch[0]
+
+
+def test_readcache_targeted_invalidation_is_exact():
+    cache = ReadCache(budget_bytes=1 << 20)
+    cache.observe_epoch(1)
+    calls: list = []
+
+    def fetch_fn(miss):
+        calls.append(np.asarray(miss).tolist())
+        return [np.asarray(miss, np.float64).reshape(-1, 1).copy()]
+
+    ids = np.arange(8, dtype=np.uint64)
+    cache.fetch(("dense", ("f",)), ids, fetch_fn)
+    cache.advance_epoch(2, ids=np.asarray([3, 5], np.uint64), rows=[])
+    calls.clear()
+    cache.fetch(("dense", ("f",)), ids, fetch_fn)
+    # ONLY the published ids were dropped; the rest stayed warm
+    assert calls == [[3, 5]]
+    # a non-adjacent epoch can't trust targeted sets: full flush
+    cache.advance_epoch(9, ids=np.asarray([1], np.uint64), rows=[])
+    calls.clear()
+    cache.fetch(("dense", ("f",)), ids, fetch_fn)
+    assert calls and len(calls[0]) == 8
+
+
+# ---------------------------------------------------------------------------
+# device lanes: dense + paged refresh_rows == fresh staging of the merge
+# ---------------------------------------------------------------------------
+
+
+def _hub_graph_dict(n=48):
+    rng = np.random.default_rng(7)
+    nodes = [
+        {"id": i + 1, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense",
+                       "value": rng.normal(size=3).tolist()}]}
+        for i in range(n)
+    ]
+    edges = []
+    for i in range(n):
+        deg = 40 if i == 0 else 3  # hub spans multiple 16-slot pages
+        for j in range(deg):
+            edges.append(
+                {"src": i + 1, "dst": (i + j + 1) % n + 1, "type": 0,
+                 "weight": float(1 + (i + j) % 5), "features": []}
+            )
+    return {"nodes": nodes, "edges": edges}
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_device_refresh_rows_matches_fresh_stage(layout):
+    import jax
+
+    from euler_tpu.dataflow import DeviceSageFlow
+
+    g = Graph.from_json(_hub_graph_dict(), num_partitions=1)
+    flow = DeviceSageFlow(
+        g, fanouts=[4, 3], batch_size=8, layout=layout, max_degree=64
+    )
+    w = GraphWriter(g)
+    w.upsert_edges([1, 2, 5], [3, 9, 30], [0, 0, 0], [9.0, 4.0, 2.0])
+    w.delete_edges([3], [5], [0])
+    res = w.publish()
+    assert flow.refresh_rows(g, res["rows"]) > 0
+    fresh = DeviceSageFlow(
+        g, fanouts=[4, 3], batch_size=8, layout=layout, max_degree=64
+    )
+    a = jax.tree_util.tree_leaves(
+        jax.jit(flow.sample)(jax.random.PRNGKey(3))
+    )
+    b = jax.tree_util.tree_leaves(
+        jax.jit(fresh.sample)(jax.random.PRNGKey(3))
+    )
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"{layout}: post-restage draws diverged from a fresh staging"
+        )
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+def test_device_refresh_rows_guards_structural_growth(layout):
+    from euler_tpu.dataflow import DeviceSageFlow
+
+    g = Graph.from_json(_hub_graph_dict(), num_partitions=1)
+    flow = DeviceSageFlow(
+        g, fanouts=[2], batch_size=4, layout=layout, max_degree=64
+    )
+    w = GraphWriter(g)
+    n = 48
+    for d in range(60):  # grow node 2 far past its staged capacity
+        w.upsert_edges([2], [(d + 3) % n + 1], [0], [1.0])
+    res = w.publish()
+    with pytest.raises(ValueError, match="outgrew|fresh device flow"):
+        flow.refresh_rows(g, res["rows"])
+    # node-count changes can't be patched either
+    w2 = GraphWriter(g)
+    w2.upsert_nodes([1000], [0], [1.0])
+    r2 = w2.publish()
+    with pytest.raises(ValueError, match="node count changed"):
+        flow.refresh_rows(g, r2["rows"])
+
+
+def test_feature_cache_ring_on_publish_converges():
+    from euler_tpu.estimator import DeviceFeatureCache
+    from euler_tpu.estimator.feature_cache import ResidualFetchRing
+
+    g = Graph.from_json(_graph_dict(), num_partitions=2)
+    cache = DeviceFeatureCache(g, ["feat"])
+    ring = ResidualFetchRing(cache, g)
+    try:
+        ring.poll_epoch()  # record the pre-publish epochs
+        w = GraphWriter(g)
+        w.upsert_nodes(
+            [2, 3], [0, 1], [1.0, 1.0],
+            dense={"feat": [[9, 9, 9, 9], [8, 8, 8, 8]]},
+        )
+        res = w.publish()
+        assert ring.on_publish(res)  # eager writer-side path
+        ring.flush()
+        rows = g.lookup_rows(np.asarray([2, 3], np.uint64))
+        got = np.asarray(cache.gather(np.asarray(rows) + 1))
+        assert np.allclose(got, [[9, 9, 9, 9], [8, 8, 8, 8]])
+        # a later poll_epoch sees the published epochs as current (no
+        # duplicate refresh scheduled)
+        assert ring.poll_epoch() is False
+    finally:
+        ring.close()
+
+
+# ---------------------------------------------------------------------------
+# wire lane: idempotent retries under PR-4 fault injection
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster2(tmp_path):
+    from euler_tpu.distributed import connect
+    from euler_tpu.distributed.service import serve_shard
+
+    base = _graph_dict(n=20)
+    d = str(tmp_path / "graph")
+    convert_json(base, d, num_partitions=2)
+    reg = str(tmp_path / "reg")
+    services = [
+        serve_shard(d, p, registry_path=reg, native=False) for p in range(2)
+    ]
+    g = connect(registry_path=reg, num_shards=2)
+    yield base, g, services
+    for s in services:
+        s.stop()
+
+
+def test_retried_batches_apply_once_under_chaos(cluster2):
+    base, g, services = cluster2
+    muts = [
+        ("ue", 1, 6, 0, 5.0),
+        ("ue", 2, 7, 0, 4.0),
+        ("de", 3, 5, 1),
+        ("un", 2, 0, 6.0, {"feat": [4.0, 4.0, 4.0, 4.0]}),
+    ]
+    # the server stages each batch, then TEARS the response frame: the
+    # client sees a transport fault and retries the SAME idempotency key
+    plan = FaultPlan(
+        [
+            Fault(kind="truncate", site="server", op="upsert_edges",
+                  count=1),
+            Fault(kind="truncate", site="server", op="upsert_nodes",
+                  count=1),
+        ],
+        seed=3,
+    )
+    chaos.install(plan)
+    try:
+        w = GraphWriter(g)
+        _route(w, muts)
+        w.publish()
+    finally:
+        chaos.uninstall()
+    fired = sum(f.fired for f in plan.faults)
+    retried = sum(sh.retry_count for sh in g.shards)
+    assert fired >= 1 and retried >= 1, (fired, retried)
+    # exactly-once proof: the merged server stores equal the from-scratch
+    # build — a double-applied retry would duplicate the appended edges
+    _, ref_shards = build_from_json(_apply_json(base, muts), 2)
+    for p, svc in enumerate(services):
+        _assert_arrays_equal(svc.store.arrays, ref_shards[p], f"part{p}")
+
+
+def test_old_server_degrade_is_typed_fast_fail(cluster2):
+    _, g, services = cluster2
+    from euler_tpu.distributed.errors import RpcError
+
+    # a server predating the mutation verbs answers unknown-op: the
+    # writer surfaces it typed (never transport-retried) and the READ
+    # path of that server keeps working
+    sh = g.shards[0]
+    with pytest.raises(RpcError, match="unknown op"):
+        sh.call("definitely_not_upsert", ["k"])
+    assert int(sh.call("num_nodes", [])[0]) > 0
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end scenario: online training + fleet serving under a
+# seeded mutation stream
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_online_mutation_stream(tmp_path):
+    from euler_tpu.dataflow import FullNeighborDataFlow
+    from euler_tpu.distributed import connect
+    from euler_tpu.distributed.service import serve_shard
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.models import GraphSAGESupervised
+    from euler_tpu.serving import InferenceRuntime, ModelServer, ServingClient
+
+    n = 24
+    base = _graph_dict(n=n)
+    data_dir = str(tmp_path / "graph")
+    convert_json(base, data_dir, num_partitions=2)
+    reg = str(tmp_path / "reg")
+    services = [
+        serve_shard(data_dir, p, registry_path=reg, native=False)
+        for p in range(2)
+    ]
+    servers: list = []
+    clients: list = []
+    try:
+        rg = connect(registry_path=reg, num_shards=2)
+        model = GraphSAGESupervised(dims=[8, 8], label_dim=2)
+        cfg = EstimatorConfig(
+            model_dir=str(tmp_path / "ckpt"), log_steps=10**9
+        )
+        mkflow = lambda graph: FullNeighborDataFlow(  # noqa: E731
+            graph, ["feat"], num_hops=2, max_degree=4,
+            label_feature="label",
+        )
+        flow = mkflow(rg)
+        est = Estimator(
+            model,
+            node_batches(rg, flow, 8, rng=np.random.default_rng(5)),
+            cfg,
+        )
+        est.train(total_steps=1, log=False)  # checkpoint for serving
+        # a 2-replica serving fleet over the live (mutable) remote graph
+        runtimes = [
+            InferenceRuntime(model, mkflow(rg), cfg, buckets=(8,))
+            for _ in range(2)
+        ]
+        for rt in runtimes:
+            rt.warmup()
+        servers = [
+            ModelServer(rt, max_wait_us=200).start() for rt in runtimes
+        ]
+        client = ServingClient(
+            [(s.host, s.port) for s in servers], routing="consistent_hash"
+        )
+        clients.append(client)
+        serve_ids = np.arange(1, 9, dtype=np.uint64)
+        watch_ids = np.asarray([2, 3], np.uint64)
+
+        # background hot-path load: readers + serving predicts, zero
+        # typed-error leaks allowed, every value whole-epoch
+        stop = threading.Event()
+        leaks: list = []
+        observed_feats: list = []
+        observed_preds: list = []
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    observed_feats.append(
+                        rg.get_dense_feature(watch_ids, ["feat"]).copy()
+                    )
+            except Exception as e:  # noqa: BLE001
+                leaks.append(repr(e))
+
+        def predictor():
+            try:
+                while not stop.is_set():
+                    observed_preds.append(client.predict(serve_ids))
+            except Exception as e:  # noqa: BLE001
+                leaks.append(repr(e))
+
+        threads = [
+            threading.Thread(target=reader, daemon=True),
+            threading.Thread(target=predictor, daemon=True),
+        ]
+        for t in threads:
+            t.start()
+
+        # the seeded mutation stream: 3 published epochs
+        waves = [
+            [
+                ("un", 2, 0, 2.0, {"feat": [float(10 * k + j)
+                                            for j in range(4)]}),
+                ("un", 3, 1, 1.0, {"feat": [float(10 * k + j + 4)
+                                            for j in range(4)]}),
+                ("ue", 4, (4 + k) % n + 1, 0, float(2 + k)),
+                ("de", (5 + k), (5 + k + 3) % n + 1, 1),
+            ]
+            for k in range(1, 4)
+        ]
+        merged = base
+        writer = GraphWriter(rg)
+        epoch_feat_oracle = [
+            Graph.from_json(base, 2).get_dense_feature(watch_ids, ["feat"])
+        ]
+        pred_oracle_rows = None
+        for k, muts in enumerate(waves, start=1):
+            _route(writer, muts)
+            res = writer.publish()
+            assert res["epochs"] == {0: k, 1: k}
+            merged = _apply_json(merged, muts)
+            local = Graph.from_json(merged, 2)
+            epoch_feat_oracle.append(
+                local.get_dense_feature(watch_ids, ["feat"])
+            )
+            # serving fleet converges on the new epoch after its poll
+            for rt in runtimes:
+                rt.poll_graph_epoch()
+            # host-lane bit parity: remote reads == from-scratch build
+            assert np.array_equal(
+                rg.get_dense_feature(watch_ids, ["feat"]),
+                local.get_dense_feature(watch_ids, ["feat"]),
+            )
+            q_remote = flow.query(serve_ids)
+            q_local = mkflow(local).query(serve_ids)
+            import jax
+
+            for a, b in zip(
+                jax.tree_util.tree_leaves(q_remote),
+                jax.tree_util.tree_leaves(q_local),
+            ):
+                assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                    f"epoch {k}: remote training batch != from-scratch"
+                )
+            # online training continues on the mutated graph
+            est.train(total_steps=2, log=False, save=False)
+            # post-publish predictions are replica-consistent + stable
+            p1 = client.predict(serve_ids)
+            p2 = client.predict(serve_ids)
+            assert np.array_equal(p1, p2)
+            pred_oracle_rows = p1
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not leaks, leaks[:5]
+
+        # every concurrently-observed value is a WHOLE-EPOCH value for
+        # its id, and each id's reads progress monotonically through the
+        # epochs (ids live on different shards whose publishes are
+        # sequential, so the per-ID — per-shard-snapshot — guarantee is
+        # the contract; a torn or stale-after-flush value would appear
+        # here as a byte pattern matching no epoch, or a regression)
+        for j in range(len(watch_ids)):
+            allowed = {
+                o[j].tobytes(): k for k, o in enumerate(epoch_feat_oracle)
+            }
+            seq = []
+            for arr in observed_feats:
+                b = arr[j].tobytes()
+                assert b in allowed, (
+                    f"id {int(watch_ids[j])}: observed value matches no "
+                    "published epoch (torn read)"
+                )
+                seq.append(allowed[b])
+            assert seq == sorted(seq), (
+                f"id {int(watch_ids[j])}: reads regressed to an older epoch"
+            )
+        assert observed_preds, "no serving traffic observed"
+
+        # final oracle: the live fleet over the mutated remote graph ==
+        # a fresh runtime over a from-scratch build of the merged graph
+        local = Graph.from_json(merged, 2)
+        offline = InferenceRuntime(
+            model, mkflow(local), cfg, buckets=(8,)
+        )
+        assert np.array_equal(
+            pred_oracle_rows, offline.predict(serve_ids)
+        ), "served rows diverged from the from-scratch merged oracle"
+    finally:
+        stop_err = None
+        for c in clients:
+            try:
+                c.close()
+            except Exception as e:  # noqa: BLE001
+                stop_err = e
+        for s in servers:
+            s.stop()
+        for s in services:
+            s.stop()
+        if stop_err is not None:
+            raise stop_err
+
+
+# ---------------------------------------------------------------------------
+# write CLI
+# ---------------------------------------------------------------------------
+
+
+def test_write_cli_selftest(capsys):
+    from euler_tpu.tools.write import main
+
+    assert main(["--selftest"]) == 0
+    assert "selftest ok" in capsys.readouterr().out

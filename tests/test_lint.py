@@ -89,6 +89,11 @@ def test_gate_covers_the_package():
         "euler_tpu/dataflow/device.py",
         "euler_tpu/ops/pallas_kernels.py",
         "euler_tpu/distributed/cache.py",
+        # the streaming-mutation lane (ISSUE 8): delta buffers merged
+        # under the store lock and the batched writer client — exactly
+        # the lock-discipline / unbounded-cache hazard classes
+        "euler_tpu/graph/delta.py",
+        "euler_tpu/distributed/writer.py",
         "bench.py",
     ):
         assert must in rels, f"{must} escaped the lint gate"

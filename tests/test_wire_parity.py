@@ -12,13 +12,18 @@ import pytest
 
 from euler_tpu.distributed.client import RemoteShard
 from euler_tpu.distributed.service import GraphService
+from euler_tpu.distributed.writer import GraphWriter
 from euler_tpu.query import plan as query_plan
 from euler_tpu.serving.client import ServingClient
 from euler_tpu.serving.server import ModelServer
 
 
 def test_graph_domain_tables_match():
-    client_verbs = set(RemoteShard.WIRE_VERBS) | set(query_plan.WIRE_VERBS)
+    client_verbs = (
+        set(RemoteShard.WIRE_VERBS)
+        | set(query_plan.WIRE_VERBS)
+        | set(GraphWriter.WIRE_VERBS)
+    )
     assert client_verbs == set(GraphService.HANDLED_VERBS), (
         "graph-protocol verb tables diverged:\n"
         f"  client-only: {sorted(client_verbs - GraphService.HANDLED_VERBS)}\n"
@@ -127,3 +132,49 @@ def test_remote_shard_client_surface_stays_inside_its_table():
     assert sent, "recording transport saw no traffic"
     stray = set(sent) - set(RemoteShard.WIRE_VERBS)
     assert not stray, f"client methods sent undeclared verbs: {sorted(stray)}"
+
+
+def test_graph_writer_surface_stays_inside_its_table():
+    """Runtime twin for the mutation lane (ISSUE 8): a GraphWriter over
+    a recording transport proves every verb it puts on the wire is in
+    its declared table — the same outer bound the static checker diffs
+    against GraphService.HANDLED_VERBS."""
+    sent = []
+
+    class _Recording:
+        part = 0
+        shard = 0
+
+        def call(self, op, values):
+            sent.append(op)
+            if op == "get_meta":
+                raise ConnectionError("recording only")
+            if op == "publish_epoch":
+                return [1, np.empty(0, np.int64), np.empty(0, np.uint64), 1]
+            return [len(values[1]) if len(values) > 1 else 0, True]
+
+        def on_publish(self, *a, **k):
+            pass
+
+    class _G:
+        meta = None
+        num_shards = 1
+        shards = [_Recording()]
+
+        def refresh_shard_weights(self):
+            pass
+
+    w = GraphWriter(_G())
+    w.upsert_nodes([1], [0], [1.0])
+    w.upsert_edges([1], [2], [0], [2.0])
+    w.delete_edges([1], [2], [0])
+    w.flush()
+    try:
+        w.publish()
+    except Exception:
+        pass  # get_meta raises on the recording transport
+    assert sent, "recording transport saw no writer traffic"
+    stray = set(sent) - set(GraphWriter.WIRE_VERBS)
+    assert not stray, f"writer sent undeclared verbs: {sorted(stray)}"
+    assert {"upsert_nodes", "upsert_edges", "delete_edges",
+            "publish_epoch"} <= set(sent)
