@@ -608,6 +608,121 @@ def _mutation_lane(smoke: bool) -> dict:
     }
 
 
+def _durability_lane(smoke: bool) -> dict:
+    """Durability lane (ISSUE 9; EULER_BENCH_DURABILITY=0 opt-out):
+    acked-writes/s through the full stage+WAL path with fsync on vs off
+    (the fsync-cadence vs write-throughput tradeoff SCALE.md documents),
+    snapshot cost at the publish cadence, crash→recovered-first-read
+    latency, and the recovered == pre-crash bit-parity oracle."""
+    import shutil
+    import tempfile
+
+    from euler_tpu.distributed.service import GraphService
+    from euler_tpu.graph import Graph
+    from euler_tpu.graph import wal as walmod
+    from euler_tpu.graph.store import GraphStore
+
+    n, batches, rows_per = (50, 40, 64) if smoke else (2000, 200, 256)
+    rng = np.random.default_rng(17)
+    nodes = [
+        {"id": i + 1, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense",
+                       "value": rng.normal(size=8).tolist()}]}
+        for i in range(n)
+    ]
+    edges = [
+        {"src": s, "dst": s % n + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for s in range(1, n + 1)
+    ]
+    data = {"nodes": nodes, "edges": edges}
+    tmp = tempfile.mkdtemp(prefix="etpu_bench_wal_")
+    old_fsync = os.environ.get("EULER_TPU_WAL_FSYNC")
+    try:
+
+        def acked_writes_per_sec(mode: str) -> tuple[float, GraphService]:
+            os.environ["EULER_TPU_WAL_FSYNC"] = mode
+            g = Graph.from_json(data, num_partitions=1)
+            svc = GraphService(
+                g.shards[0], g.meta, 0,
+                wal_dir=os.path.join(tmp, f"wal_{mode}"),
+            )
+            r = np.random.default_rng(5)
+            reqs = []
+            for b in range(batches):
+                src = r.integers(1, n + 1, rows_per).astype(np.uint64)
+                dst = r.integers(1, n + 1, rows_per).astype(np.uint64)
+                reqs.append([
+                    f"bench:{mode}:{b}", src, dst,
+                    np.zeros(rows_per, np.int32),
+                    r.random(rows_per).astype(np.float32),
+                    np.empty(0, np.uint64), np.empty(0, np.uint64),
+                    np.empty(0, np.int32), np.empty(0, np.float32),
+                ])
+            t0 = time.perf_counter()
+            for a in reqs:
+                svc.dispatch("upsert_edges", a)  # staged + logged + synced
+            dt = time.perf_counter() - t0
+            return batches * rows_per / dt, svc
+
+        fsync_rate, svc = acked_writes_per_sec("batch")
+        nofsync_rate, svc_off = acked_writes_per_sec("off")
+        svc_off.stop()
+
+        # snapshot cost at the cadence point: publish, then serialize the
+        # published store + applied window and trim the WAL
+        svc.dispatch("publish_epoch", ["bench:pub"])
+        t0 = time.perf_counter()
+        assert svc.snapshot_now()
+        snapshot_ms = (time.perf_counter() - t0) * 1e3
+        # a post-snapshot acked suffix, so recovery replays WAL too
+        svc.dispatch("upsert_edges", [
+            "bench:suffix",
+            np.asarray([1], np.uint64), np.asarray([2], np.uint64),
+            np.zeros(1, np.int32), np.asarray([2.0], np.float32),
+            np.empty(0, np.uint64), np.empty(0, np.uint64),
+            np.empty(0, np.int32), np.empty(0, np.float32),
+        ])
+        live = {
+            k: np.array(v) for k, v in svc.store.arrays.items()
+        }
+        # crash: no graceful stop — recovery gets only what hit the disk
+        svc.server.shutdown()
+        svc.server.server_close()
+        g2 = Graph.from_json(data, num_partitions=1)
+        t0 = time.perf_counter()
+        rec = walmod.recover(
+            g2.meta, 0, os.path.join(tmp, "wal_batch"), g2.shards[0]
+        )
+        rec.store.get_dense_feature(
+            np.arange(1, min(n, 64) + 1, dtype=np.uint64), ["feat"]
+        )
+        recovery_ms = (time.perf_counter() - t0) * 1e3
+        parity = set(live) == set(rec.store.arrays) and all(
+            np.array_equal(np.asarray(rec.store.arrays[k]), live[k])
+            for k in live
+        )
+        return {
+            "durability": True,
+            "durability_acked_writes_per_sec_fsync": round(fsync_rate, 1),
+            "durability_acked_writes_per_sec_nofsync": round(
+                nofsync_rate, 1
+            ),
+            "durability_fsync_overhead_x": round(
+                nofsync_rate / max(fsync_rate, 1e-9), 3
+            ),
+            "durability_snapshot_ms": round(snapshot_ms, 2),
+            "durability_recovery_ms": round(recovery_ms, 2),
+            "durability_recovered_bit_parity": bool(parity),
+        }
+    finally:
+        if old_fsync is None:
+            os.environ.pop("EULER_TPU_WAL_FSYNC", None)
+        else:
+            os.environ["EULER_TPU_WAL_FSYNC"] = old_fsync
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run(platform: str) -> tuple[float, dict]:
     from euler_tpu.dataflow import SageDataFlow
     from euler_tpu.datasets.synthetic import random_graph
@@ -753,6 +868,18 @@ def run(platform: str) -> tuple[float, dict]:
 
             traceback.print_exc()
             extra.update({"mutation": False, "mutation_error": repr(e)[:300]})
+    # durability lane (ISSUE 9) — acked-writes/s fsync A/B, snapshot
+    # cost, crash→recovered-first-read, recovered bit-parity oracle
+    if os.environ.get("EULER_BENCH_DURABILITY", "1") != "0":
+        try:
+            extra.update(_durability_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update(
+                {"durability": False, "durability_error": repr(e)[:300]}
+            )
     probe = _probe_meta()
     if probe:
         extra["probe"] = probe

@@ -16,6 +16,14 @@ Check ids:
                      load-bearing (dispatch gates on them, the runtime
                      parity test in tests/test_wire_parity.py instantiates
                      them), so drift means the gate and the code diverged
+  wire-wal-drift   — the WAL's record-type table (graph/wal.py WAL_VERBS)
+                     disagrees with the writer's MUTATION verbs (its
+                     WIRE_VERBS minus the read-only exemptions): a
+                     mutation verb on the wire without a WAL record type
+                     would be acked but silently NON-DURABLE — lost on
+                     the next shard crash despite the fsync-before-ack
+                     contract; a stale WAL-only verb is a record type
+                     recovery can replay but nothing can ever write
 
 Extraction (AST, not grep):
   sent    — ``<obj>.call("verb", ...)`` / ``<obj>.submit("verb", ...)`` /
@@ -315,6 +323,81 @@ def _union_drift(findings, domain, tables, truth, what):
     )
 
 
+# -- WAL record-type lockstep (durability lane, ISSUE 9) --------------------
+
+# the WAL's declared record-type table; must equal the writer's mutation
+# verbs = GraphWriter.WIRE_VERBS minus the read-only verbs it also sends
+WAL_TABLE = ("euler_tpu/graph/wal.py", "WAL_VERBS")
+WAL_CLIENT = "euler_tpu/distributed/writer.py"
+WAL_READ_ONLY = ("get_meta",)
+
+
+def _named_table(mod: Module, name: str) -> tuple[list[str], int] | None:
+    """Module-level `name = frozenset({...})` of string literals."""
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    vals = _str_elements(stmt.value)
+                    if vals is not None:
+                        return vals, stmt.lineno
+    return None
+
+
+def check_wal_lockstep(
+    project: Project,
+    wal_table: tuple = WAL_TABLE,
+    client_path: str = WAL_CLIENT,
+    read_only: tuple = WAL_READ_ONLY,
+) -> list[Finding]:
+    wal_path, table_name = wal_table
+    wal_mod = project.module(wal_path)
+    client_mod = project.module(client_path)
+    if wal_mod is None or client_mod is None:
+        return []  # durability lane not in this project slice
+    table = _named_table(wal_mod, table_name)
+    if table is None:
+        return [
+            Finding(
+                "wire-wal-drift",
+                CHECKER,
+                wal_path,
+                1,
+                table_name,
+                f"{table_name} table missing from {wal_path} — the WAL"
+                " record-type gate has nothing to enforce",
+            )
+        ]
+    wal_verbs, line = set(table[0]), table[1]
+    mutation = set()
+    for _, (vals, _ln) in extract_tables(client_mod).items():
+        mutation |= set(vals)
+    mutation -= set(read_only)
+    missing = sorted(mutation - wal_verbs)
+    extra = sorted(wal_verbs - mutation)
+    if not missing and not extra:
+        return []
+    parts = []
+    if missing:
+        parts.append(
+            f"mutation verbs with NO WAL record type (acked but"
+            f" non-durable): {missing}"
+        )
+    if extra:
+        parts.append(f"WAL record types no writer ever sends: {extra}")
+    return [
+        Finding(
+            "wire-wal-drift",
+            CHECKER,
+            wal_path,
+            line,
+            table_name,
+            f"{table_name} out of lockstep with {client_path}'s mutation"
+            f" verbs: {'; '.join(parts)}",
+        )
+    ]
+
+
 @register
 class WireProtocolChecker(Checker):
     name = CHECKER
@@ -324,4 +407,5 @@ class WireProtocolChecker(Checker):
         out: list[Finding] = []
         for domain in self.domains:
             out.extend(check_domain(project, domain))
+        out.extend(check_wal_lockstep(project))
         return out

@@ -424,6 +424,15 @@ class FullNeighborDataFlow(DataFlow):
             root_features=not skip_root_feats,
         )
         seed = int(self.rng.integers(0, 2**63 - 1))
+        # epoch stamps for the write-back below, captured BEFORE the
+        # RPC: a publish landing while the plan is in flight must void
+        # the seeding (insert-time epoch check), not let pre-publish
+        # rows re-enter the cache stamped as the new epoch
+        seed_epochs = None
+        if not rows_mode and self.feature_names:
+            from euler_tpu.distributed.cache import snapshot_epochs
+
+            seed_epochs = snapshot_epochs(self.graph)
         res = run_plan(
             self.graph, plan, roots, seed, fused=plan_mode() == "fused"
         )
@@ -468,7 +477,8 @@ class FullNeighborDataFlow(DataFlow):
                 if h == 0 and skip_root_feats:
                     continue  # those rows came FROM the cache
                 seed_dense_rows(
-                    self.graph, hop_ids[h], self.feature_names, feats[h]
+                    self.graph, hop_ids[h], self.feature_names, feats[h],
+                    epochs=seed_epochs,
                 )
         else:
             feats = tuple(

@@ -12,6 +12,7 @@ from euler_tpu.distributed.errors import (  # noqa: F401
 from euler_tpu.distributed.registry import Registry  # noqa: F401
 from euler_tpu.distributed.retry import RetryBudget, RetryPolicy  # noqa: F401
 from euler_tpu.distributed.service import GraphService, serve_shard  # noqa: F401
+from euler_tpu.distributed.supervisor import ShardSupervisor  # noqa: F401
 from euler_tpu.distributed.rendezvous import (  # noqa: F401
     RendezvousServer,
     TcpRegistry,

@@ -52,6 +52,10 @@ import numpy as np
 # bytes objects) added to each block's payload bytes for the budget
 _ENTRY_OVERHEAD = 96
 
+# "no epoch stamp passed" sentinel for insert_rows (None is a real
+# epoch value: never-observed)
+_UNSET = object()
+
 # cache keys whose per-entry identifier is a LOCAL ROW, not a node id —
 # targeted publish invalidation (advance_epoch) must match them against
 # the merge's mutated-row set instead of its touched-id set
@@ -357,15 +361,24 @@ class ReadCache:
         self.dedup_ids += int(ids.size - len(uniq))
         return out
 
-    def insert_rows(self, key: tuple, ids, *components) -> None:
+    def insert_rows(self, key: tuple, ids, *components, ep=_UNSET) -> None:
         """Client-side write-back: store already-received rows (e.g. a
         fused exec_plan response) under `key`. The caller's contract is
         that each row equals what the keyed verb would return for that
-        id — which holds for any deterministic read the server answered."""
+        id — which holds for any deterministic read the server answered.
+
+        `ep` must be the epoch observed when the RESPONSE'S FETCH
+        STARTED (capture it with `snapshot_epochs` before the RPC).
+        Defaulting it to the insert-time epoch is only safe when no
+        fetch separates capture from insert — a publish landing mid-
+        flight would otherwise re-seed pre-publish bytes AFTER the
+        invalidation swept past, stamped as the new epoch (the
+        serve-under-mutation regression tests/test_delta.py pins)."""
         ids = np.asarray(ids).reshape(-1)
         if ids.size == 0:
             return
-        ep = self.epoch  # write-back rows carry their response's epoch
+        if ep is _UNSET:
+            ep = self.epoch
         uniq, first = np.unique(ids, return_index=True)
         comps = [np.ascontiguousarray(a) for a in components]
         self._register_meta(key, comps)
@@ -427,12 +440,30 @@ def clear_graph_caches(graph) -> None:
         c.clear()
 
 
-def seed_dense_rows(graph, ids, names, values) -> None:
+def snapshot_epochs(graph) -> dict[int, object]:
+    """Per-shard cache epochs, to capture BEFORE a fetch whose response
+    will be written back (`seed_dense_rows(..., epochs=...)`): a
+    write-back must carry the epoch its fetch STARTED under, or a
+    publish landing mid-flight re-seeds pre-publish bytes after the
+    invalidation sweep, stamped as current."""
+    out: dict[int, object] = {}
+    for s, sh in enumerate(getattr(graph, "shards", []) or []):
+        c = getattr(sh, "_cache", None)
+        if isinstance(c, ReadCache):
+            out[s] = c.epoch
+    return out
+
+
+def seed_dense_rows(graph, ids, names, values, epochs=None) -> None:
     """Write dense feature rows that arrived via a FUSED plan response
     into the owning shards' read caches (keyed exactly like the
     `get_dense_feature` verb). Fused responses bypass the per-verb cache
     on the way in; seeding them keeps warm-plan runs able to skip their
-    root feature step, and later direct fetches of the same hot ids free."""
+    root feature step, and later direct fetches of the same hot ids free.
+
+    `epochs` is `snapshot_epochs(graph)` captured BEFORE the plan RPC;
+    without it the insert is stamped at insert time, which is only safe
+    when no publish can land between the fetch and this call."""
     shards = getattr(graph, "shards", None)
     if not shards:
         return
@@ -449,7 +480,8 @@ def seed_dense_rows(graph, ids, names, values) -> None:
             continue
         sel = np.nonzero(owner == s)[0]
         if len(sel):
-            c.insert_rows(key, ids[sel], values[sel])
+            ep = _UNSET if epochs is None else epochs.get(s)
+            c.insert_rows(key, ids[sel], values[sel], ep=ep)
 
 
 def dense_coverage(graph, ids, names) -> bool:

@@ -404,9 +404,15 @@ class RemoteShard:
                 r.drop()
                 # quarantine under the pool lock: _pick reads bad_until
                 # under it, and an unguarded write could be reordered
-                # against a racing reader's round-robin scan
+                # against a racing reader's round-robin scan. A transport
+                # fault also voids the epoch handshake: the peer may be a
+                # SUPERVISED RESTART of a crashed shard, so the next
+                # cached read re-learns graph_epoch over `stats` before
+                # trusting any cached block (bit-identical recovery makes
+                # this a no-op flush; a lossy one flushes stale bytes)
                 with self._lock:
                     r.bad_until = time.time() + self.QUARANTINE_S
+                    self._epoch_checked = False
                 attempt += 1
                 if attempt >= attempts:
                     break

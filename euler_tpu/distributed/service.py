@@ -378,10 +378,52 @@ class GraphService:
         port: int = 0,
         registry: Registry | None = None,
         workers: int | None = None,
+        wal_dir: str | None = None,
     ):
         self.store = store
         self.meta = meta
         self.shard = shard
+        # streaming-mutation state (graph/delta.py): staged writes are
+        # invisible to readers until publish_epoch merges them and swaps
+        # self.store in ONE reference assignment (dispatch binds
+        # `s = self.store` once per request, so reads are never torn).
+        # _applied is the bounded idempotency-key window that makes
+        # retried writer batches apply-once, across publishes included;
+        # all three fields are guarded by _delta_lock.
+        self._delta = None
+        self._applied: collections.OrderedDict = collections.OrderedDict()
+        self._delta_lock = threading.Lock()
+        # durability (graph/wal.py): with a wal_dir, every acked mutation
+        # is fsync'd to the WAL before its response leaves, snapshots run
+        # on the publish cadence, and construction FIRST recovers the
+        # store from snapshot + WAL-suffix replay — the socket only binds
+        # (below) once the shard serves the recovered epoch.
+        self.wal_dir = wal_dir
+        self._wal = None
+        self.recovering = False
+        self.recovery_report: dict | None = None
+        self._last_snapshot_epoch: int | None = None
+        self._publish_count = 0
+        # (store, applied-window copy, wal position) captured atomically
+        # at each publish — the only states a snapshot may persist (a
+        # mid-delta snapshot would trim acked-but-unpublished records)
+        self._snap_state: tuple | None = None
+        self._snap_busy = threading.Lock()
+        if wal_dir is not None:
+            from euler_tpu.graph import wal as walmod
+
+            self.recovering = True
+            rec = walmod.recover(
+                meta, shard, wal_dir, store,
+                applied_keys_max=self.APPLIED_KEYS_MAX,
+                publish_result_cap=self.PUBLISH_RESULT_CAP,
+            )
+            self.store = rec.store
+            self._delta = rec.delta
+            self._applied = rec.applied
+            self._wal = rec.wal
+            self.recovery_report = rec.report
+            self.recovering = False
         # _PoolServer reads this before spawning coordinator threads
         self.may_coordinate = meta.num_partitions > 1
         self.server = _PoolServer((host, port), self, workers)
@@ -395,16 +437,6 @@ class GraphService:
         # updates race benignly across pool workers — it is telemetry,
         # not an invariant.
         self.op_counts: collections.Counter = collections.Counter()
-        # streaming-mutation state (graph/delta.py): staged writes are
-        # invisible to readers until publish_epoch merges them and swaps
-        # self.store in ONE reference assignment (dispatch binds
-        # `s = self.store` once per request, so reads are never torn).
-        # _applied is the bounded idempotency-key window that makes
-        # retried writer batches apply-once, across publishes included;
-        # all three fields are guarded by _delta_lock.
-        self._delta = None
-        self._applied: collections.OrderedDict = collections.OrderedDict()
-        self._delta_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -427,6 +459,8 @@ class GraphService:
             self.server.drain(drain_s)
         self.server.shutdown()
         self.server.server_close()
+        if self._wal is not None:
+            self._wal.close()
 
     # -- cluster facade (worker-to-worker fan-out) -----------------------
 
@@ -542,13 +576,20 @@ class GraphService:
                 "delta_pending": (
                     0 if delta is None else delta.pending()["rows"]
                 ),
+                # durability lag (graph/wal.py): bytes of acked-but-not-
+                # yet-snapshotted WAL, the epoch the newest snapshot
+                # covers (null = none yet / WAL off), and whether the
+                # shard is mid-recovery. Old clients ignore the fields.
+                "wal_bytes": self._wal.size() if self._wal else 0,
+                "last_snapshot_epoch": self._last_snapshot_epoch,
+                "recovering": bool(self.recovering),
             })]
         if op == "upsert_nodes":
-            return self._stage_mutation(a[0], "nodes", a[1:])
+            return self._stage_mutation(op, a)
         if op == "upsert_edges":
-            return self._stage_mutation(a[0], "edges", a[1:])
+            return self._stage_mutation(op, a)
         if op == "delete_edges":
-            return self._stage_mutation(a[0], "edge_dels", a[1:])
+            return self._stage_mutation(op, a)
         if op == "publish_epoch":
             return self._publish_epoch(a[0] if a else None)
         if op == "num_nodes":
@@ -727,15 +768,25 @@ class GraphService:
     # rows=None (full-invalidate) instead of caching huge arrays
     PUBLISH_RESULT_CAP = 65536
 
-    def _stage_mutation(self, key, kind: str, args: list) -> list:
+    def _stage_mutation(self, op: str, a: list) -> list:
         """Stage one writer batch into the shard's delta overlay.
 
         [n_staged, applied] — applied=False means the idempotency key
         was already seen (the writer's transport retry of a batch whose
         response got lost): the batch is NOT re-staged, so a retried
         upsert never double-applies. Overflow past the delta's row bound
-        raises the typed OverloadError (never transport-retried)."""
-        key = str(key)
+        raises the typed OverloadError (never transport-retried) BEFORE
+        anything is buffered or logged.
+
+        Durability: the WAL record is written under the delta lock (so
+        log order == staging order and replay can never diverge) and
+        fsync'd AFTER the lock drops but BEFORE this returns — the ack
+        never races ahead of the disk, and concurrent stagers share one
+        group-committed fsync."""
+        from euler_tpu.graph import wal as walmod
+
+        key = str(a[0])
+        seq = None
         with self._delta_lock:
             hit = self._applied.get(key)
             if hit is not None:
@@ -747,17 +798,23 @@ class GraphService:
                 delta = self._delta = DeltaStore(
                     self.shard, self.meta.num_partitions
                 )
-            if kind == "nodes":
-                n = delta.stage_nodes(
-                    args[0], args[1], args[2], args[3] or [], args[4]
-                )
-            elif kind == "edges":
-                n = delta.stage_edges(*args[:8])
-            else:
-                n = delta.stage_edge_deletes(*args[:6])
+            n = walmod.stage_record(delta, op, a)
+            if self._wal is not None:
+                try:
+                    seq, _ = self._wal.write(op, a)
+                except OSError:
+                    # disk full/IO error AFTER the rows staged (no roll
+                    # back): record the key so a retry can't double-apply
+                    # in THIS process, then surface the typed failure —
+                    # the batch is applied in memory but NOT durable
+                    # (OPERATIONS.md disk-full row)
+                    self._applied[key] = True
+                    raise
             self._applied[key] = True
             while len(self._applied) > self.APPLIED_KEYS_MAX:
                 self._applied.popitem(last=False)
+        if seq is not None:
+            self._wal.commit(seq)
         return [n, True]
 
     def _publish_epoch(self, key) -> list:
@@ -769,6 +826,8 @@ class GraphService:
         None row/id sets tell the client to fully flush its cache (used
         for oversized stale sets and for retried publishes whose first
         response was lost)."""
+        seq = None
+        snapshot_due = False
         with self._delta_lock:
             if key is not None:
                 hit = self._applied.get(f"pub:{key}")
@@ -810,7 +869,82 @@ class GraphService:
                 self._applied[f"pub:{key}"] = tuple(result)
                 while len(self._applied) > self.APPLIED_KEYS_MAX:
                     self._applied.popitem(last=False)
+            if self._wal is not None:
+                seq, pos = self._wal.write("publish_epoch", [key])
+                self._publish_count += 1
+                # the ONLY WAL positions a snapshot may cover: here the
+                # store, the applied window, and the log position agree
+                # (staged-but-unpublished records all sit past `pos`)
+                self._snap_state = (
+                    self.store,
+                    collections.OrderedDict(self._applied),
+                    pos,
+                )
+                from euler_tpu.graph.wal import snapshot_every
+
+                every = snapshot_every()
+                snapshot_due = bool(
+                    every and self._publish_count % every == 0
+                )
+        if seq is not None:
+            self._wal.commit(seq)
+        if snapshot_due:
+            self._spawn_snapshot()
         return result
+
+    # -- snapshots (graph/wal.py) ----------------------------------------
+
+    def _spawn_snapshot(self) -> bool:
+        """Kick one background snapshot of the last published state; a
+        snapshot already in flight skips (the next cadence hit catches
+        up). The dispatch path never blocks: the captured store is an
+        immutable published object, serialized off-thread."""
+        if not self._snap_busy.acquire(blocking=False):
+            return False
+        t = threading.Thread(
+            target=self._snapshot_run, daemon=True,
+            name=f"shard{self.shard}-snapshot",
+        )
+        t.start()
+        return True
+
+    def _snapshot_run(self) -> None:
+        # _snap_busy is held (acquired by the caller); release on exit
+        try:
+            with self._delta_lock:
+                state = self._snap_state
+            if state is None:
+                return
+            store, applied, pos = state
+            from euler_tpu.graph import wal as walmod
+
+            walmod.write_snapshot(
+                self.wal_dir, int(store.graph_epoch), store.arrays,
+                applied, pos,
+            )
+            self._wal.trim(pos)
+            self._last_snapshot_epoch = int(store.graph_epoch)
+        except Exception as e:  # snapshot failure must not cost serving:
+            # the WAL still holds everything, recovery just replays more
+            import sys
+
+            print(
+                f"# shard {self.shard}: snapshot failed ({e!r}); WAL"
+                " retained",
+                file=sys.stderr,
+            )
+        finally:
+            self._snap_busy.release()
+
+    def snapshot_now(self) -> bool:
+        """Synchronous snapshot of the last published state (operators,
+        bench, tests). False when the WAL is off or nothing has been
+        published yet."""
+        if self._wal is None or self._snap_state is None:
+            return False
+        self._snap_busy.acquire()
+        self._snapshot_run()
+        return True
 
     def _sage_minibatch(
         self, batch_size, edge_types, counts, label, node_type, seed, lean
@@ -912,8 +1046,14 @@ def serve_shard(
     registry_path: str | None = None,
     native: bool | None = None,
     workers: int | None = None,
+    wal_dir: str | None = None,
 ) -> GraphService:
-    """Load shard `shard` of the dataset at data_dir and serve it."""
+    """Load shard `shard` of the dataset at data_dir and serve it.
+
+    With `wal_dir`, the shard is DURABLE: boot first recovers from the
+    newest snapshot + WAL-suffix replay (bit-identical to the pre-crash
+    published epoch), then serves; every acked mutation is WAL-logged
+    before its response and snapshots run on the publish cadence."""
     meta = GraphMeta.load(data_dir)
     part_dir = os.path.join(data_dir, f"part_{shard}")
     arrays = tformat.read_arrays(part_dir)
@@ -934,7 +1074,8 @@ def serve_shard(
         store = GraphStore(meta, arrays, shard)
     registry = make_registry(registry_path) if registry_path else None
     return GraphService(
-        store, meta, shard, host, port, registry, workers=workers
+        store, meta, shard, host, port, registry, workers=workers,
+        wal_dir=wal_dir,
     ).start()
 
 
@@ -946,6 +1087,9 @@ def main(argv=None):
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--registry", default=None)
     ap.add_argument("--no-native", action="store_true")
+    ap.add_argument("--wal-dir", default=None,
+                    help="durability dir (WAL + snapshots); boot recovers"
+                         " from it, mutations fsync to it before the ack")
     args = ap.parse_args(argv)
     svc = serve_shard(
         args.data,
@@ -954,7 +1098,14 @@ def main(argv=None):
         args.port,
         args.registry,
         native=False if args.no_native else None,
+        wal_dir=args.wal_dir,
     )
+    if svc.recovery_report and svc.recovery_report.get("recovered"):
+        print(
+            f"shard {args.shard} recovered: "
+            f"{json.dumps(svc.recovery_report)}",
+            flush=True,
+        )
     print(f"serving shard {args.shard} on {svc.host}:{svc.port}", flush=True)
 
     # SIGTERM (orchestrator-initiated shutdown) drains: deregister, stop

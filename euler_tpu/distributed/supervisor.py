@@ -1,0 +1,338 @@
+"""Shard supervisor — spawn, monitor, and restart durable graph shards.
+
+PR 4's chaos discipline made a dead shard SURVIVABLE (readers fail over,
+retries stop, errors stay typed) but never brought it back: a `kill -9`'d
+shard stayed dead forever. With the WAL + snapshot layer (graph/wal.py)
+a restart is cheap and LOSSLESS, so the supervisor closes the loop:
+
+- `start()` spawns one `python -m euler_tpu.distributed.service` process
+  per shard on FIXED ports (clients hold static replica lists — a
+  restart must come back on the address they already know) with a
+  per-shard `--wal-dir`.
+- A monitor thread polls the children; an exited shard (crash, OOM-kill,
+  `kill -9`) is respawned with exponential backoff, bounded by
+  `max_restarts` within the backoff window (a healthy stretch of uptime
+  resets the counter — crash loops stop, one-off crashes do not).
+- The restarted process recovers from its WAL dir (newest snapshot +
+  log-suffix replay — bit-identical to the pre-crash published epoch),
+  re-registers its heartbeat, and resumes serving. Clients un-quarantine
+  on their normal timed revival and re-run the ReadCache epoch handshake
+  (transport faults void `_epoch_checked`), so readers resume without a
+  restart on their side.
+
+CLI (start a whole durable cluster under supervision):
+
+    python -m euler_tpu.distributed.supervisor --data DIR --shards 2 \
+        --registry /path/reg --wal-root /path/wal
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from euler_tpu.distributed import wire
+
+
+def _free_port(host: str) -> int:
+    """An OS-assigned free port (released immediately — the standard
+    pick-then-bind race, narrowed by SO_REUSEADDR on the server side)."""
+    s = socket.socket()
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def _ping(host: str, port: int, timeout_s: float = 1.0):
+    """One raw ping RPC; the shard index on success, None otherwise."""
+    try:
+        with socket.create_connection((host, port), timeout=timeout_s) as s:
+            s.settimeout(timeout_s)
+            wire.send_frame(s, wire.encode("ping", []))
+            payload = wire.read_frame(s)
+            if payload is None:
+                return None
+            status, result = wire.decode(payload)
+            if status == "ok":
+                return int(result[0])
+    except (OSError, ValueError):
+        return None
+    return None
+
+
+class _Shard:
+    """Supervision state for one shard process."""
+
+    def __init__(self, shard: int, port: int, wal_dir: str):
+        self.shard = shard
+        self.port = port
+        self.wal_dir = wal_dir
+        self.proc: subprocess.Popen | None = None
+        self.restarts = 0
+        self.window_restarts = 0  # restarts inside the current crash loop
+        self.started_at = 0.0
+        self.next_spawn_at = 0.0  # backoff gate
+        self.failed = False  # crash loop exceeded max_restarts
+        self.log_path: str | None = None
+
+
+class ShardSupervisor:
+    """Process supervisor for a durable multi-shard graph service."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        num_shards: int,
+        registry_path: str,
+        wal_root: str,
+        host: str = "127.0.0.1",
+        ports: list[int] | None = None,
+        max_restarts: int = 8,
+        backoff_s: float = 0.25,
+        backoff_max_s: float = 5.0,
+        healthy_uptime_s: float = 30.0,
+        poll_s: float = 0.1,
+        native: bool = False,
+        env: dict | None = None,
+    ):
+        self.data_dir = data_dir
+        self.num_shards = int(num_shards)
+        self.registry_path = registry_path
+        self.wal_root = wal_root
+        self.host = host
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.healthy_uptime_s = float(healthy_uptime_s)
+        self.poll_s = float(poll_s)
+        self.native = native
+        self.env = dict(env) if env else None
+        os.makedirs(wal_root, exist_ok=True)
+        ports = (
+            list(ports)
+            if ports is not None
+            else [_free_port(host) for _ in range(self.num_shards)]
+        )
+        if len(ports) != self.num_shards:
+            raise ValueError("need one port per shard")
+        self.shards = [
+            _Shard(i, int(ports[i]), os.path.join(wal_root, f"shard_{i}"))
+            for i in range(self.num_shards)
+        ]
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._monitor: threading.Thread | None = None
+
+    # -- process control -------------------------------------------------
+
+    def _spawn(self, sh: _Shard) -> None:
+        # callers (start(), the monitor loop) hold self._lock across this
+        os.makedirs(sh.wal_dir, exist_ok=True)
+        cmd = [
+            sys.executable, "-m", "euler_tpu.distributed.service",
+            "--data", self.data_dir,
+            "--shard", str(sh.shard),
+            "--host", self.host,
+            "--port", str(sh.port),
+            "--registry", self.registry_path,
+            "--wal-dir", sh.wal_dir,
+        ]
+        if not self.native:
+            cmd.append("--no-native")
+        sh.log_path = os.path.join(self.wal_root, f"shard_{sh.shard}.log")
+        env = dict(os.environ if self.env is None else self.env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log = open(sh.log_path, "ab")
+        try:
+            # its own session: a Ctrl-C to the supervisor's group must
+            # not take the children down uncontrolled — stop() drains
+            # graftlint: disable=lock-unguarded-write -- every caller holds self._lock around _spawn
+            sh.proc = subprocess.Popen(
+                cmd, stdout=log, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True,
+            )
+        finally:
+            log.close()
+        # graftlint: disable=lock-unguarded-write -- every caller holds self._lock around _spawn
+        sh.started_at = time.monotonic()
+
+    def start(self) -> "ShardSupervisor":
+        # under the lock: _spawn writes per-shard state the monitor and
+        # stats() read under it (sh.proc / sh.started_at)
+        with self._lock:
+            for sh in self.shards:
+                self._spawn(sh)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="shard-supervisor"
+        )
+        self._monitor.start()
+        return self
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            with self._lock:
+                for sh in self.shards:
+                    p = sh.proc
+                    if sh.failed or p is None:
+                        continue
+                    if p.poll() is None:
+                        # a healthy stretch closes the crash-loop window
+                        if (
+                            sh.window_restarts
+                            and now - sh.started_at > self.healthy_uptime_s
+                        ):
+                            sh.window_restarts = 0
+                        continue
+                    if sh.next_spawn_at == 0.0:
+                        # just observed the exit: schedule the respawn
+                        sh.window_restarts += 1
+                        if sh.window_restarts > self.max_restarts:
+                            sh.failed = True
+                            print(
+                                f"# supervisor: shard {sh.shard} crash-"
+                                f"looped past max_restarts="
+                                f"{self.max_restarts}; giving up on it"
+                                f" (exit {p.returncode})",
+                                file=sys.stderr, flush=True,
+                            )
+                            continue
+                        pause = min(
+                            self.backoff_s * 2 ** (sh.window_restarts - 1),
+                            self.backoff_max_s,
+                        )
+                        sh.next_spawn_at = now + pause
+                    elif now >= sh.next_spawn_at:
+                        sh.next_spawn_at = 0.0
+                        sh.restarts += 1
+                        print(
+                            f"# supervisor: restarting shard {sh.shard}"
+                            f" (exit {p.returncode},"
+                            f" restart #{sh.restarts})",
+                            file=sys.stderr, flush=True,
+                        )
+                        self._spawn(sh)
+            self._stop.wait(self.poll_s)
+
+    # -- operator surface ------------------------------------------------
+
+    def kill(self, shard: int, sig: int = signal.SIGKILL) -> None:
+        """Send `sig` to one shard process (chaos harness + tests: the
+        seeded `kill -9` the recovery proof injects)."""
+        with self._lock:
+            p = self.shards[shard].proc
+        if p is not None and p.poll() is None:
+            os.kill(p.pid, sig)
+
+    def wait_healthy(self, timeout_s: float = 60.0) -> bool:
+        """Block until EVERY shard answers ping on its fixed port (and
+        with it has re-registered its heartbeat). False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        pending = set(range(self.num_shards))
+        while pending and time.monotonic() < deadline:
+            for i in sorted(pending):
+                sh = self.shards[i]
+                if _ping(self.host, sh.port) == sh.shard:
+                    pending.discard(i)
+            if pending:
+                time.sleep(0.1)
+        return not pending
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "shards": {
+                    sh.shard: {
+                        "port": sh.port,
+                        "alive": bool(
+                            sh.proc is not None and sh.proc.poll() is None
+                        ),
+                        "restarts": sh.restarts,
+                        "failed": sh.failed,
+                        "pid": getattr(sh.proc, "pid", None),
+                    }
+                    for sh in self.shards
+                },
+            }
+
+    def cluster(self) -> dict[int, list[tuple[str, int]]]:
+        """Static cluster spec for `distributed.connect(cluster=...)` —
+        stable across restarts because ports are fixed."""
+        return {sh.shard: [(self.host, sh.port)] for sh in self.shards}
+
+    def stop(self, term_timeout_s: float = 10.0) -> None:
+        """Stop supervising, then the children: SIGTERM (the service
+        drains: deregister → finish in-flight → exit), SIGKILL
+        stragglers."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        with self._lock:
+            procs = [sh.proc for sh in self.shards if sh.proc is not None]
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + term_timeout_s
+        for p in procs:
+            remaining = max(deadline - time.monotonic(), 0.1)
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                    p.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", required=True)
+    ap.add_argument("--shards", type=int, required=True)
+    ap.add_argument("--registry", required=True)
+    ap.add_argument("--wal-root", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--ports", default=None,
+                    help="comma-separated fixed ports (default: auto)")
+    ap.add_argument("--max-restarts", type=int, default=8)
+    ap.add_argument("--native", action="store_true")
+    args = ap.parse_args(argv)
+    ports = (
+        [int(p) for p in args.ports.split(",")] if args.ports else None
+    )
+    sup = ShardSupervisor(
+        args.data, args.shards, args.registry, args.wal_root,
+        host=args.host, ports=ports, max_restarts=args.max_restarts,
+        native=args.native,
+    ).start()
+    healthy = sup.wait_healthy(timeout_s=120.0)
+    print(json.dumps({"healthy": healthy, **sup.stats()}), flush=True)
+    done = threading.Event()
+
+    def _term(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    try:
+        done.wait()
+    except KeyboardInterrupt:
+        pass
+    sup.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,10 +93,50 @@ class GraphWriter:
         # entries with their original keys
         self._outbox: list = []  # (shard_idx, verb, values)
         self._local_deltas: dict = {}
+        self._closed = False
         # telemetry (GIL-racy increments fine — repo counter stance)
         self.batches_sent = 0
         self.rows_sent = 0
         self.publishes = 0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        """Flush the pending outbox, then seal the writer.
+
+        Staged-but-unflushed batches are NEVER silently dropped: close
+        sends them, and any failure surfaces typed (the outbox keeps the
+        unsent entries under their original idempotency keys, so a
+        caller that handles the error can flush() again before the
+        writer goes away). Idempotent; staging after close raises."""
+        if self._closed:
+            return
+        try:
+            self.flush()
+        finally:
+            # sealed even when flush raised: the error told the caller
+            # exactly what was at risk, and a retried flush() on the
+            # original keys is still safe — but NEW batches must not
+            # quietly pile into a writer that is being torn down
+            self._closed = True
+
+    def __enter__(self) -> "GraphWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()  # flush errors surface to the caller, typed
+        else:
+            # the body already failed — try to save the staged batches,
+            # but never mask the original error with a flush failure
+            try:
+                self.close()
+            except Exception:
+                pass
+
+    def _ensure_open(self) -> None:
+        if self._closed:
+            raise ValueError("GraphWriter is closed")
 
     # -- buffering --------------------------------------------------------
 
@@ -104,6 +144,7 @@ class GraphWriter:
         """Buffer node upserts. `dense` is {feature_name: [n, dim]};
         provided features replace, missing ones keep their values (new
         nodes default them to zeros — builder semantics)."""
+        self._ensure_open()
         ids = _u64(ids)
         n = len(ids)
         types = _i32(types if types is not None else np.zeros(n))
@@ -126,6 +167,7 @@ class GraphWriter:
         return n
 
     def upsert_edges(self, src, dst, types=None, weights=None) -> int:
+        self._ensure_open()
         src = _u64(src)
         dst = _u64(dst)
         n = len(src)
@@ -138,6 +180,7 @@ class GraphWriter:
         return n
 
     def delete_edges(self, src, dst, types=None) -> int:
+        self._ensure_open()
         src = _u64(src)
         dst = _u64(dst)
         types = _i32(types if types is not None else np.zeros(len(src)))
@@ -151,6 +194,7 @@ class GraphWriter:
         """Local graphs only: node deletion is not a wire verb (the
         remote protocol streams node/edge upserts and edge deletes; node
         retirement is an offline rebuild concern)."""
+        self._ensure_open()
         if any(hasattr(s, "call") for s in self.graph.shards):
             raise ValueError(
                 "delete_nodes is not a wire verb — rebuild the remote "

@@ -145,6 +145,9 @@ class ModelServer:
                 reloads=getattr(self.runtime, "reloads", 0),
                 uptime_s=round(time.monotonic() - self._started, 3),
             )
+            durability = self._graph_durability()
+            if durability is not None:
+                stats["graph_shards"] = durability
             return [json.dumps(stats)]
         if op == "ping":
             return [0]
@@ -153,6 +156,38 @@ class ModelServer:
         raise RuntimeError(
             f"op {op!r} is in HANDLED_VERBS but has no dispatch arm"
         )
+
+    def _graph_durability(self) -> dict | None:
+        """Per-shard durability lag of the graph this server reads
+        (remote shards only — their `stats` verb carries `wal_bytes` /
+        `last_snapshot_epoch` / `recovering`). Surfaces through
+        `server_stats` → `ServingClient.fleet_stats()`, so operators see
+        how far the serving fleet's graph is from its last snapshot
+        without polling the graph tier separately. None for in-process
+        graphs (no wire, publish swaps are their durability story)."""
+        flow = getattr(self.runtime, "flow", None)
+        graph = getattr(flow, "graph", None)
+        out: dict = {}
+        for sh in getattr(graph, "shards", []) or []:
+            if not hasattr(sh, "call") or not hasattr(sh, "stats"):
+                continue  # local store: no stats verb
+            key = str(getattr(sh, "shard", len(out)))
+            try:
+                # tight deadline: a dead graph shard shows up as an error
+                # entry in ~1s instead of stalling server_stats behind
+                # the full transport retry budget
+                s = json.loads(sh.call("stats", [], deadline_s=1.0)[0])
+            except Exception as e:  # a dead shard must show up, not vanish
+                out[key] = {"error": repr(e)[:200]}
+                continue
+            out[key] = {
+                k: s.get(k)
+                for k in (
+                    "graph_epoch", "wal_bytes", "last_snapshot_epoch",
+                    "recovering", "delta_pending",
+                )
+            }
+        return out or None
 
     def _reload(self, a: list) -> dict:
         """Hot-swap the runtime's checkpoint with a canary bit-parity

@@ -143,6 +143,58 @@ def watch_reload(servers, model_dir: str, stop_event, poll_s: float):
                 )
 
 
+def _durability_probe(graph_json: dict, watch_ids) -> dict:
+    """Boot one DURABLE graph shard (WAL + snapshots) in a temp dir,
+    stream a couple of mutations through the wire, and report the
+    operator-facing durability stats — the selftest's proof that
+    `wal_bytes` / `last_snapshot_epoch` / `recovering` surface end to
+    end, and what a fleet's `graph_shards` section will carry."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from euler_tpu.distributed import connect
+    from euler_tpu.distributed.service import serve_shard
+    from euler_tpu.distributed.writer import GraphWriter
+    from euler_tpu.graph.builder import convert_json
+
+    tmp = tempfile.mkdtemp(prefix="etpu_serve_durability_")
+    svc = None
+    try:
+        data_dir = f"{tmp}/graph"
+        convert_json(graph_json, data_dir, num_partitions=1)
+        svc = serve_shard(
+            data_dir, 0, native=False, wal_dir=f"{tmp}/wal",
+        )
+        graph = connect(cluster={0: [(svc.host, svc.port)]})
+        with GraphWriter(graph) as w:
+            w.upsert_edges(
+                np.asarray(watch_ids, np.uint64),
+                np.roll(np.asarray(watch_ids, np.uint64), 1),
+                None,
+                np.full(len(watch_ids), 2.0, np.float32),
+            )
+            w.flush()
+            pre = graph.shards[0].stats()
+            w.publish()
+        svc.snapshot_now()
+        post = graph.shards[0].stats()
+        return {
+            "wal_bytes": int(pre.get("wal_bytes", 0)),
+            "wal_bytes_after_snapshot": int(post.get("wal_bytes", 0)),
+            "last_snapshot_epoch": post.get("last_snapshot_epoch"),
+            "recovering": post.get("recovering"),
+            "graph_epoch": post.get("graph_epoch"),
+        }
+    except Exception as e:  # surfaced in the JSON, fails the selftest
+        return {"error": repr(e)[:200]}
+    finally:
+        if svc is not None:
+            svc.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def selftest(replicas: int = 1, hedge_ms: float | None = None) -> int:
     """In-process boot: synthetic graph → 2-step checkpoint → fleet +
     concurrent clients → bit-parity vs direct inference. Exit 0 = the
@@ -266,8 +318,14 @@ def selftest(replicas: int = 1, hedge_ms: float | None = None) -> int:
     )
     for s in servers:
         s.stop()
+    durability = _durability_probe(
+        {"nodes": nodes, "edges": edges}, all_ids[:4]
+    )
+    ok = ok and durability.get("wal_bytes", 0) > 0
+    ok = ok and durability.get("recovering") is False
     out = {
         "selftest": "ok" if ok else "MISMATCH",
+        "durability": durability,
         "replicas": len(addrs),
         "requests": requests if len(addrs) > 1 else stats["requests"],
         "batches": batches_n if len(addrs) > 1 else stats["batches"],

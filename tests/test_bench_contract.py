@@ -90,6 +90,18 @@ def test_bench_smoke_emits_final_json_line():
     assert row["mutation_publish_ms_large"] > 0
     assert row["mutation_read_recovery_ms"] > 0
     assert row["mutation_read_rate_post_over_pre"] > 0
+    # the durability lane (ISSUE 9) must not silently vanish: acked
+    # writes/s with fsync on vs off (the cadence/throughput tradeoff),
+    # snapshot cost, crash→recovered-first-read latency, and the
+    # recovered == pre-crash bit-parity oracle all ride the artifact
+    assert row["durability"] is True, row
+    assert row["durability_recovered_bit_parity"] is True, row
+    assert row["durability_acked_writes_per_sec_fsync"] > 0
+    assert row["durability_acked_writes_per_sec_nofsync"] > 0
+    # fsync can only cost throughput, never add it (allow noise)
+    assert row["durability_fsync_overhead_x"] >= 0.8, row
+    assert row["durability_snapshot_ms"] > 0
+    assert row["durability_recovery_ms"] > 0
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline

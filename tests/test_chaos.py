@@ -142,8 +142,13 @@ def test_replica_kill_failover_bit_identical(
         losses_chaos, rpcs_chaos, retries_chaos = run(plan)
 
         np.testing.assert_array_equal(losses_ok, losses_chaos)
-        # same logical call stream, and real failovers happened
-        assert rpcs_chaos == rpcs_ok
+        # same logical call stream — except that since round 11 every
+        # transport fault voids the shard's epoch handshake (the faulted
+        # peer may be a supervised restart), so the chaos run adds one
+        # `stats` re-check per faulted shard per quarantine window (here:
+        # 2, +slack for a quarantine expiring mid-run); and real
+        # failovers happened. Never FEWER calls: that would be skipping.
+        assert rpcs_ok <= rpcs_chaos <= rpcs_ok + 4
         assert retries_chaos > 0
     finally:
         for s in services:

@@ -94,6 +94,11 @@ def test_gate_covers_the_package():
         # the lock-discipline / unbounded-cache hazard classes
         "euler_tpu/graph/delta.py",
         "euler_tpu/distributed/writer.py",
+        # the durability lane (ISSUE 9): group-committed WAL appends and
+        # the process supervisor's monitor/restart state — lock-discipline
+        # territory, plus the wire-wal-drift lockstep gate below
+        "euler_tpu/graph/wal.py",
+        "euler_tpu/distributed/supervisor.py",
         "bench.py",
     ):
         assert must in rels, f"{must} escaped the lint gate"
@@ -202,6 +207,66 @@ def test_wire_protocol_fixture_trips():
 def test_wire_protocol_fixed_form_clean():
     project = _fixture_project("wire_good_client.py", "wire_good_server.py")
     assert check_domain(project, _FIXTURE_DOMAIN_GOOD) == []
+
+
+_WAL_WRITER_SRC = (
+    "class W:\n"
+    "    WIRE_VERBS = frozenset({\n"
+    "        'get_meta', 'upsert_nodes', 'upsert_edges', 'delete_edges',\n"
+    "        'publish_epoch',\n"
+    "    })\n"
+)
+
+
+def _wal_project(wal_verbs: str) -> Project:
+    from euler_tpu.analysis.checkers.wire_protocol import WAL_CLIENT, WAL_TABLE
+
+    wal_src = f"WAL_VERBS = frozenset({{{wal_verbs}}})\n"
+    return Project(
+        [
+            Module(WAL_TABLE[0], WAL_TABLE[0], wal_src),
+            Module(WAL_CLIENT, WAL_CLIENT, _WAL_WRITER_SRC),
+        ],
+        root=".",
+    )
+
+
+def test_wal_lockstep_drift_trips():
+    """A mutation verb with no WAL record type (acked but non-durable)
+    and a WAL-only record type (unwritable) must both trip."""
+    from euler_tpu.analysis.checkers.wire_protocol import check_wal_lockstep
+
+    missing = check_wal_lockstep(
+        _wal_project("'upsert_nodes', 'upsert_edges', 'publish_epoch'")
+    )
+    assert len(missing) == 1 and missing[0].check == "wire-wal-drift"
+    assert "delete_edges" in missing[0].message
+    assert "non-durable" in missing[0].message
+    extra = check_wal_lockstep(
+        _wal_project(
+            "'upsert_nodes', 'upsert_edges', 'delete_edges',"
+            " 'publish_epoch', 'compact_shard'"
+        )
+    )
+    assert len(extra) == 1 and "compact_shard" in extra[0].message
+
+
+def test_wal_lockstep_fixed_form_clean():
+    from euler_tpu.analysis.checkers.wire_protocol import check_wal_lockstep
+
+    assert check_wal_lockstep(
+        _wal_project(
+            "'upsert_nodes', 'upsert_edges', 'delete_edges',"
+            " 'publish_epoch'"
+        )
+    ) == []
+    # the real repo's tables are in lockstep at HEAD (also covered by the
+    # gate, but assert it here with the runtime objects so a drift names
+    # this test, not a generic lint failure)
+    from euler_tpu.distributed.writer import GraphWriter
+    from euler_tpu.graph.wal import WAL_VERBS
+
+    assert WAL_VERBS == GraphWriter.WIRE_VERBS - {"get_meta"}
 
 
 # ---------------------------------------------------------------------------

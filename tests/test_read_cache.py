@@ -270,6 +270,62 @@ def test_minibatch_parity_cached_vs_uncached_both_lanes(cluster, monkeypatch):
         sh._epoch_checked = False
 
 
+def test_write_back_stamped_with_prefetch_epoch():
+    """The serve-under-mutation regression (fixed in round 11): a fused
+    plan response fetched BEFORE a publish must not be write-back-seeded
+    AFTER `advance_epoch` swept — stamped with the pre-fetch epoch, the
+    insert-time check rejects it; stamped at insert time (the old
+    behavior) it would re-enter as pre-publish bytes under the new epoch
+    and a later reader would regress to the old epoch's value."""
+    cache = ReadCache(budget_bytes=1 << 20)
+    cache.observe_epoch(1)
+    key = ("dense", ("feat",))
+    ids = np.asarray([3], np.uint64)
+    pre_fetch_epoch = cache.epoch  # captured before the (slow) plan RPC
+    # ... the response (epoch-1 bytes) is in flight when a publish lands:
+    cache.advance_epoch(2, ids=ids, rows=[])
+    cache.insert_rows(
+        key, ids, np.full((1, 4), 1.0, np.float32), ep=pre_fetch_epoch
+    )
+    assert not cache.covers(key, ids)  # stale write-back rejected
+    # a write-back whose fetch started under the CURRENT epoch lands
+    cache.insert_rows(
+        key, ids, np.full((1, 4), 2.0, np.float32), ep=cache.epoch
+    )
+    (got,) = cache.fetch(key, ids, lambda miss: [np.zeros((len(miss), 4))])
+    np.testing.assert_array_equal(got, np.full((1, 4), 2.0, np.float32))
+
+
+def test_snapshot_epochs_capture():
+    from euler_tpu.distributed.cache import seed_dense_rows, snapshot_epochs
+
+    class _Shard:
+        def __init__(self):
+            self._cache = ReadCache(budget_bytes=1 << 20)
+            self._cache.observe_epoch(5)
+
+    class _G:
+        shards = [_Shard(), _Shard()]
+
+    g = _G()
+    eps = snapshot_epochs(g)
+    assert eps == {0: 5, 1: 5}
+    # seeding with the captured epochs lands while epochs still match...
+    ids = np.asarray([2, 3], np.uint64)
+    seed_dense_rows(
+        g, ids, ("feat",), np.ones((2, 4), np.float32), epochs=eps
+    )
+    assert g.shards[0]._cache.covers(("dense", ("feat",)), [2])
+    assert g.shards[1]._cache.covers(("dense", ("feat",)), [3])
+    # ...and is rejected for a shard whose epoch moved mid-flight
+    g.shards[1]._cache.advance_epoch(6, ids=ids, rows=[])
+    seed_dense_rows(
+        g, ids, ("x",), np.ones((2, 4), np.float32), epochs=eps
+    )
+    assert g.shards[0]._cache.covers(("dense", ("x",)), [2])
+    assert not g.shards[1]._cache.covers(("dense", ("x",)), [3])
+
+
 def test_eviction_bound_under_tiny_budget():
     cache = ReadCache(budget_bytes=4096, stripes=2)
     key = ("dense", ("feat",))

@@ -1,0 +1,360 @@
+"""Supervised restart + crash recovery under live traffic (ISSUE 9).
+
+The acceptance proof the ISSUE pins: a seeded `kill -9` of a shard
+process MID-MUTATION-STREAM, under concurrent training and fleet
+serving, and the supervisor restarts it from its WAL/snapshot dir —
+after which the recovered cluster is BIT-IDENTICAL to a from-scratch
+build of exactly the acked mutations, idempotent retries that straddled
+the crash applied once, and no typed error ever leaked to a reader.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.distributed import connect
+from euler_tpu.distributed.supervisor import ShardSupervisor, _ping
+from euler_tpu.distributed.writer import GraphWriter
+from euler_tpu.graph import Graph
+from euler_tpu.graph import format as tformat
+from euler_tpu.graph import wal as walmod
+from euler_tpu.graph.builder import build_from_json, convert_json
+from euler_tpu.graph.meta import GraphMeta
+from euler_tpu.graph.store import GraphStore
+
+
+def _graph_dict(n=24, feat_dim=4, seed=0):
+    rng = np.random.default_rng(seed)
+    nodes = [
+        {
+            "id": i,
+            "type": i % 2,
+            "weight": float(1 + i % 3),
+            "features": [
+                {"name": "feat", "type": "dense",
+                 "value": rng.normal(size=feat_dim).tolist()},
+                {"name": "label", "type": "dense",
+                 "value": [1.0, 0.0] if i % 2 else [0.0, 1.0]},
+            ],
+        }
+        for i in range(1, n + 1)
+    ]
+    edges = [
+        {"src": s, "dst": (s + off) % n + 1, "type": off % 2,
+         "weight": float(1 + (s + off) % 4), "features": []}
+        for s in range(1, n + 1)
+        for off in (1, 3, 7)
+    ]
+    return {"nodes": nodes, "edges": edges}
+
+
+def _apply_json(data, muts):
+    """The from-scratch reference: apply mutations to the JSON dict."""
+    data = {
+        "nodes": [dict(x) for x in data["nodes"]],
+        "edges": [dict(x) for x in data["edges"]],
+    }
+    for m in muts:
+        kind = m[0]
+        if kind == "un":
+            _, nid, t, w, feats = m
+            rec = next((x for x in data["nodes"] if x["id"] == nid), None)
+            if rec is None:
+                rec = {"id": nid, "type": t, "weight": w, "features": []}
+                data["nodes"].append(rec)
+            rec["type"], rec["weight"] = t, w
+            fl = [dict(f) for f in rec.get("features", [])]
+            for name, vals in feats.items():
+                hit = next((f for f in fl if f["name"] == name), None)
+                if hit is None:
+                    fl.append(
+                        {"name": name, "type": "dense", "value": list(vals)}
+                    )
+                else:
+                    hit["value"] = list(vals)
+            rec["features"] = fl
+        elif kind == "ue":
+            _, s, d, t, w = m
+            rec = next(
+                (e for e in data["edges"]
+                 if e["src"] == s and e["dst"] == d and e["type"] == t),
+                None,
+            )
+            if rec is None:
+                data["edges"].append(
+                    {"src": s, "dst": d, "type": t, "weight": w,
+                     "features": []}
+                )
+            else:
+                rec["weight"] = w
+        elif kind == "de":
+            _, s, d, t = m
+            data["edges"] = [
+                e for e in data["edges"]
+                if not (e["src"] == s and e["dst"] == d and e["type"] == t)
+            ]
+    return data
+
+
+def _route(writer, muts):
+    for m in muts:
+        if m[0] == "un":
+            _, nid, t, w, feats = m
+            writer.upsert_nodes(
+                [nid], [t], [w],
+                dense={k: [v] for k, v in feats.items()} or None,
+            )
+        elif m[0] == "ue":
+            _, s, d, t, w = m
+            writer.upsert_edges([s], [d], [t], [w])
+        elif m[0] == "de":
+            _, s, d, t = m
+            writer.delete_edges([s], [d], [t])
+
+
+def _recover_all(data_dir, wal_root, parts):
+    """In-process recovery of every shard's wal dir — what a restarted
+    process does at boot, done here so the test can diff raw arrays."""
+    meta = GraphMeta.load(data_dir)
+    stores = []
+    for p in range(parts):
+        arrays = tformat.read_arrays(os.path.join(data_dir, f"part_{p}"))
+        rec = walmod.recover(
+            meta, p, os.path.join(wal_root, f"shard_{p}"),
+            GraphStore(meta, arrays, p),
+        )
+        stores.append(rec.store)
+    return stores
+
+
+@pytest.fixture
+def cluster(tmp_path, monkeypatch):
+    # recovery correctness is the subject here, not retry-storm limits:
+    # readers + trainer + writer share each shard's retry budget, and the
+    # seeded kill makes them all spend tokens at once
+    monkeypatch.setenv("EULER_TPU_RPC_RETRY_BUDGET", "10000")
+    base = _graph_dict()
+    d = str(tmp_path / "graph")
+    convert_json(base, d, num_partitions=2)
+    sup = ShardSupervisor(
+        d, 2, str(tmp_path / "reg"), str(tmp_path / "wal"),
+        backoff_s=0.2, healthy_uptime_s=5.0,
+    ).start()
+    assert sup.wait_healthy(60), sup.stats()
+    yield base, d, str(tmp_path / "wal"), sup
+    sup.stop()
+
+
+def test_supervisor_restart_and_stats(cluster):
+    """A kill -9'd shard comes back on its FIXED port, recovered and
+    re-registered; the supervisor counts the restart; durability stats
+    flow through the wire."""
+    base, d, wal_root, sup = cluster
+    g = connect(cluster=sup.cluster())
+    w = GraphWriter(g)
+    w.upsert_edges([1, 2], [5, 6], [0, 0], [3.0, 4.0])
+    w.publish()
+    sup.kill(0, signal.SIGKILL)
+    # the write path rides the transport retries straight through the
+    # restart — no orchestration needed on the client side
+    w.upsert_edges([3], [7], [0], [9.0])
+    w.flush()
+    assert sup.wait_healthy(60), sup.stats()
+    st = sup.stats()["shards"]
+    assert st[0]["restarts"] == 1 and st[0]["alive"], st
+    assert st[1]["restarts"] == 0 and st[1]["alive"], st
+    stats = json.loads(g.shards[0].call("stats", [])[0])
+    assert stats["recovering"] is False
+    assert stats["graph_epoch"] == 1  # recovered, not reset
+    assert stats["wal_bytes"] > 0  # the staged-post-publish rows
+
+
+def test_supervisor_gives_up_on_crash_loop(tmp_path):
+    """A shard that can't boot (bad data dir) stops being respawned once
+    max_restarts is hit — supervised restart, not a fork bomb."""
+    bad = str(tmp_path / "nope")
+    os.makedirs(bad)
+    sup = ShardSupervisor(
+        bad, 1, str(tmp_path / "reg"), str(tmp_path / "wal"),
+        max_restarts=2, backoff_s=0.05, poll_s=0.05,
+    ).start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            st = sup.stats()["shards"][0]
+            if st["failed"]:
+                break
+            time.sleep(0.1)
+        st = sup.stats()["shards"][0]
+        assert st["failed"] is True
+        assert st["restarts"] <= 2
+    finally:
+        sup.stop()
+
+
+def test_scenario_kill9_recovery_under_live_traffic(cluster, tmp_path):
+    """The chaos-pinned acceptance proof (ISSUE 9):
+
+    seeded kill -9 of shard 0 MID-mutation-stream, under concurrent
+    Estimator training + 2-replica fleet serving + a hot reader →
+    supervisor restarts the shard from its WAL dir, the writer's
+    idempotent retries straddle the crash and apply once, zero typed
+    errors leak to any reader, and the recovered cluster is
+    BIT-IDENTICAL to a from-scratch build of exactly the acked
+    mutations."""
+    from euler_tpu.dataflow import FullNeighborDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig, node_batches
+    from euler_tpu.models import GraphSAGESupervised
+    from euler_tpu.serving import InferenceRuntime, ModelServer, ServingClient
+
+    base, d, wal_root, sup = cluster
+    n = 24
+    rg = connect(cluster=sup.cluster())
+    model = GraphSAGESupervised(dims=[8, 8], label_dim=2)
+    cfg = EstimatorConfig(model_dir=str(tmp_path / "ckpt"), log_steps=10**9)
+    mkflow = lambda graph: FullNeighborDataFlow(  # noqa: E731
+        graph, ["feat"], num_hops=2, max_degree=4, label_feature="label"
+    )
+    flow = mkflow(rg)
+    est = Estimator(
+        model, node_batches(rg, flow, 8, rng=np.random.default_rng(5)), cfg
+    )
+    est.train(total_steps=1, log=False)  # checkpoint for serving
+    runtimes = [
+        InferenceRuntime(model, mkflow(rg), cfg, buckets=(8,))
+        for _ in range(2)
+    ]
+    for rt in runtimes:
+        rt.warmup()
+    servers = [ModelServer(rt, max_wait_us=200).start() for rt in runtimes]
+    client = ServingClient(
+        [(s.host, s.port) for s in servers], routing="consistent_hash"
+    )
+    serve_ids = np.arange(1, 9, dtype=np.uint64)
+    watch_ids = np.asarray([2, 3], np.uint64)
+
+    stop = threading.Event()
+    leaks: list = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                rg.get_dense_feature(watch_ids, ["feat"])
+        except Exception as e:  # noqa: BLE001
+            leaks.append(f"reader: {e!r}")
+
+    def predictor():
+        try:
+            while not stop.is_set():
+                client.predict(serve_ids)
+        except Exception as e:  # noqa: BLE001
+            leaks.append(f"predictor: {e!r}")
+
+    threads = [
+        threading.Thread(target=reader, daemon=True),
+        threading.Thread(target=predictor, daemon=True),
+    ]
+    for t in threads:
+        t.start()
+
+    # the seeded mutation stream: 3 published waves; the kill lands
+    # MID-wave-2, between two acked flushes (deterministic kill point —
+    # batch 2 of the wave — on a seeded stream)
+    rng = np.random.default_rng(1234)
+    waves = []
+    for k in range(1, 4):
+        muts = [
+            ("un", 2, 0, 2.0,
+             {"feat": [float(x) for x in rng.normal(size=4)]}),
+            ("ue", int(rng.integers(1, n + 1)),
+             int(rng.integers(1, n + 1)), 0, float(2 + k)),
+            ("ue", int(rng.integers(1, n + 1)),
+             int(rng.integers(1, n + 1)), 0, float(k)),
+            ("de", (5 + k), (5 + k + 3) % n + 1, 1),
+        ]
+        waves.append(muts)
+    all_muts: list = []
+    writer = GraphWriter(rg)
+    killed = False
+    final_epochs: dict = {}
+    for k, muts in enumerate(waves, start=1):
+        for j, m in enumerate(muts):
+            _route(writer, [m])
+            writer.flush()  # acked (fsync'd server-side) batch by batch
+            all_muts.append(m)
+            if k == 2 and j == 1 and not killed:
+                killed = True
+                sup.kill(0, signal.SIGKILL)  # mid-stream, post-ack
+        res = writer.publish()
+        assert res["epochs"][0] == k, res["epochs"]
+        final_epochs = res["epochs"]
+        # training continues on the mutated graph through the crash
+        est.train(total_steps=2, log=False, save=False)
+    writer.close()
+    assert killed
+    assert sup.wait_healthy(60), sup.stats()
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert not leaks, leaks[:5]
+    assert sup.stats()["shards"][0]["restarts"] >= 1
+    # the crash was really ridden out by retries, not luck
+    assert sum(sh.retry_count for sh in rg.shards) >= 1
+
+    # from-scratch oracle of exactly the acked mutations (every batch
+    # above was acked before the next was sent — the acked set is the
+    # full stream)
+    merged = _apply_json(base, all_muts)
+    ref_meta, ref_shards = build_from_json(merged, 2)
+    local = Graph.from_json(merged, 2)
+
+    # live remote reads equal the from-scratch build, post-recovery
+    all_ids = np.arange(1, n + 1, dtype=np.uint64)
+    assert np.array_equal(
+        rg.get_dense_feature(all_ids, ["feat"]),
+        local.get_dense_feature(all_ids, ["feat"]),
+    )
+    got_nb = rg.get_full_neighbor(all_ids, None, 8)
+    want_nb = local.get_full_neighbor(all_ids, None, 8)
+    for a, b in zip(got_nb, want_nb):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    # operators see durability lag THROUGH the serving fleet: every
+    # replica's server_stats carries the graph shards' wal/snapshot state
+    fleet = client.fleet_stats(timeout_s=10.0)
+    for addr, st in fleet.items():
+        assert "graph_shards" in st, (addr, st)
+        for shard_key, row in st["graph_shards"].items():
+            assert "wal_bytes" in row and "recovering" in row, row
+            assert row["recovering"] is False
+
+    # idempotent retries across the crash applied ONCE and the recovered
+    # shards are bit-identical: stop the cluster (graceful), recover the
+    # WAL dirs in-process, and diff the raw partition arrays
+    client.close()
+    for s in servers:
+        s.stop()
+    sup.stop()
+    stores = _recover_all(d, wal_root, 2)
+    for p in range(2):
+        assert set(stores[p].arrays) == set(ref_shards[p])
+        for key in sorted(ref_shards[p]):
+            assert np.array_equal(
+                np.asarray(stores[p].arrays[key]),
+                np.asarray(ref_shards[p][key]),
+            ), f"part{p}: array {key!r} diverged from the from-scratch build"
+        # epoch restored to what the live cluster last published (a
+        # shard whose final wave staged nothing keeps its older epoch)
+        assert stores[p].graph_epoch == final_epochs[p]
+
+
+def test_ping_helper_roundtrip(cluster):
+    base, d, wal_root, sup = cluster
+    sh = sup.shards[1]
+    assert _ping(sup.host, sh.port) == 1
+    assert _ping(sup.host, 1) is None  # nothing listening
