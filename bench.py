@@ -129,6 +129,12 @@ def _write_probe_cache(ok: bool) -> None:
                 },
                 f,
             )
+            # fsync before the atomic publish: without it the rename can
+            # land while the bytes are still page-cache-only, and a crash
+            # leaves an EMPTY committed file (reads tolerate the torn
+            # JSON, but then the whole probe burn repeats)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, PROBE_CACHE_PATH)
     except OSError:
         pass
@@ -723,6 +729,127 @@ def _durability_lane(smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _resume_lane(smoke: bool) -> dict:
+    """Durable-training lane (ISSUE 10; EULER_BENCH_RESUME=0 opt-out):
+    checkpoint cost on the step path with the async writer vs inline
+    sync commits (the save-cadence vs step-time tradeoff SCALE.md
+    documents), resume-to-first-step latency, retained-checkpoint disk
+    footprint, and the `resume_bit_parity` oracle — train 2N straight vs
+    train N + fresh-process restore + N, params and per-step losses
+    bit-identical under the standing seed contract."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from euler_tpu.dataflow import FullNeighborDataFlow
+    from euler_tpu.estimator import Estimator, EstimatorConfig
+    from euler_tpu.graph import Graph
+    from euler_tpu.models import GraphSAGESupervised
+    from euler_tpu.training import (
+        CheckpointStore,
+        SessionConfig,
+        TrainingSession,
+        resumable_node_batches,
+    )
+
+    n, feat_dim, dims, half, cadence = (
+        (48, 8, [16, 16], 8, 4) if smoke else (400, 32, [64, 64], 24, 8)
+    )
+    rng = np.random.default_rng(11)
+    nodes = [
+        {"id": i + 1, "type": 0, "weight": 1.0,
+         "features": [
+             {"name": "feat", "type": "dense",
+              "value": rng.normal(size=feat_dim).tolist()},
+             {"name": "label", "type": "dense",
+              "value": [1.0, 0.0] if i % 2 else [0.0, 1.0]},
+         ]}
+        for i in range(n)
+    ]
+    edges = [
+        {"src": s, "dst": (s + d) % n + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for s in range(1, n + 1)
+        for d in (1, 2, 3)
+    ]
+    graph = Graph.from_json({"nodes": nodes, "edges": edges})
+    model = GraphSAGESupervised(dims=dims, label_dim=2)
+    tmp = tempfile.mkdtemp(prefix="etpu_bench_resume_")
+
+    def make(subdir: str, async_save: bool):
+        flow = FullNeighborDataFlow(
+            graph, ["feat"], num_hops=len(dims), max_degree=4,
+            label_feature="label",
+        )
+        source = resumable_node_batches(graph, flow, 16, seed=5)
+        est = Estimator(
+            model, source,
+            EstimatorConfig(
+                model_dir=os.path.join(tmp, subdir), log_steps=10**9
+            ),
+        )
+        return TrainingSession(
+            est, source=source, graph=graph,
+            cfg=SessionConfig(
+                checkpoint_every=cadence, async_save=async_save,
+                anomaly_policy="off",
+            ),
+        )
+
+    try:
+        # step-path checkpoint stall: inline sync commit vs host-snapshot
+        # + background writer (same cadence, same state size)
+        s_sync = make("sync", async_save=False)
+        s_sync.run(2 * half)
+        t_sync = s_sync.telemetry
+        sync_ms = t_sync["save_stall_ms_total"] / max(t_sync["saves"], 1)
+
+        s_straight = make("straight", async_save=True)
+        rep_a = s_straight.run(2 * half)
+        t_async = s_straight.telemetry
+        async_ms = (
+            t_async["save_stall_ms_total"] / max(t_async["saves"], 1)
+        )
+
+        # the kill/resume half: fresh session objects over the same
+        # model_dir = everything a dead process would have lost
+        s_b1 = make("resumed", async_save=True)
+        s_b1.run(half)
+        s_b2 = make("resumed", async_save=True)
+        t0 = time.perf_counter()
+        s_b2.restore()
+        s_b2.run(1)
+        resume_first_ms = (time.perf_counter() - t0) * 1e3
+        rep_b = s_b2.run(half - 1)
+
+        la = jax.tree_util.tree_leaves(s_straight.est.params)
+        lb = jax.tree_util.tree_leaves(s_b2.est.params)
+        parity = all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(la, lb)
+        ) and rep_a["losses"][half + 1:] == rep_b["losses"]
+
+        store = CheckpointStore(os.path.join(tmp, "straight"))
+        ckpt_bytes = 0
+        for step in store.steps():
+            d = store._path(step)
+            ckpt_bytes += sum(
+                os.path.getsize(os.path.join(d, f)) for f in os.listdir(d)
+            )
+        return {
+            "resume": True,
+            "resume_save_sync_ms": round(sync_ms, 3),
+            "resume_save_async_stall_ms": round(async_ms, 3),
+            "resume_to_first_step_ms": round(resume_first_ms, 2),
+            "resume_ckpt_bytes": int(ckpt_bytes),
+            "resume_retained_ckpts": len(store.steps()),
+            "resume_bit_parity": bool(parity),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run(platform: str) -> tuple[float, dict]:
     from euler_tpu.dataflow import SageDataFlow
     from euler_tpu.datasets.synthetic import random_graph
@@ -880,6 +1007,16 @@ def run(platform: str) -> tuple[float, dict]:
             extra.update(
                 {"durability": False, "durability_error": repr(e)[:300]}
             )
+    # durable-training resume lane (ISSUE 10) — save-stall sync vs async,
+    # resume-to-first-step latency, retained-ckpt bytes, bit-parity oracle
+    if os.environ.get("EULER_BENCH_RESUME", "1") != "0":
+        try:
+            extra.update(_resume_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update({"resume": False, "resume_error": repr(e)[:300]})
     probe = _probe_meta()
     if probe:
         extra["probe"] = probe

@@ -3,6 +3,7 @@ built-in checkers with euler_tpu.analysis.core.CHECKERS."""
 
 from euler_tpu.analysis.checkers import (  # noqa: F401
     determinism,
+    durable_write,
     jit_purity,
     lock_discipline,
     unbounded_cache,

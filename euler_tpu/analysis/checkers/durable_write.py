@@ -1,0 +1,172 @@
+"""durable-write: state files must commit via tmp + atomic rename.
+
+Check id:
+  durable-write — a write that names a checkpoint/snapshot/cache-style
+                  state file (``open(path, "w"/"wb")`` with json.dump
+                  inside, or ``np.save(path, ...)``) in a scope showing
+                  NO ``os.replace`` / ``os.rename`` — the in-place
+                  overwrite a crash can tear.
+
+Why this exists: the pre-PR-10 `Estimator.save()` overwrote ONE fixed
+checkpoint path in place — a `kill -9` landing mid-write destroyed the
+only checkpoint in existence. The repo's good form is established by
+graph/wal.py (`write_snapshot`: everything lands in a ``.tmp`` name,
+fsync'd, then published with one ``os.replace``) and now by
+training/checkpoint.py (COMMIT-marker retained checkpoints). A torn
+state file is worse than a missing one: the next reader parses garbage
+(or half-new half-old state) instead of falling back to the previous
+good version. The async-checkpoint writer thread makes this a standing
+hazard — state files are written concurrently with the process being
+killable at any byte.
+
+Scope heuristic: the written path's SOURCE TEXT (the call argument,
+plus the last local assignment of a bare name argument) must mention a
+state-file keyword — ckpt / checkpoint / snapshot / commit / cache /
+``.meta`` — so scratch outputs (embeddings, logs, reports) don't trip.
+Any ``os.replace``/``os.rename`` in the same scope counts as the idiom:
+writes inside that scope are the tmp side of a commit.
+
+Suppress with ``# graftlint: disable=durable-write -- reason`` for
+genuinely expendable files.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.core import Checker, Finding, Module, register
+from euler_tpu.analysis.symbols import dotted
+
+CHECKER = "durable-write"
+
+_KEYWORDS = ("ckpt", "checkpoint", "snapshot", "commit", "cache", ".meta")
+_RENAMES = {"os.replace", "os.rename"}
+_SAVERS = {"np.save", "numpy.save", "np.savez", "numpy.savez"}
+
+
+def _src(mod: Module, node: ast.AST) -> str:
+    try:
+        return ast.get_source_segment(mod.source, node) or ""
+    except Exception:
+        return ""
+
+
+def _path_text(mod: Module, node: ast.AST, assigns: dict[str, str]) -> str:
+    """The path argument's source text, widened one level through a
+    bare local name (``tmp = f"{CACHE_PATH}.{pid}"; open(tmp, "w")``
+    must see the state keyword in the assignment)."""
+    text = _src(mod, node)
+    if isinstance(node, ast.Name):
+        text = f"{text} {assigns.get(node.id, '')}"
+    return text.lower()
+
+
+def _open_mode(call: ast.Call) -> str | None:
+    """Literal mode of an open() call (positional or keyword), else
+    None (a dynamic mode is not this checker's business)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+def _scan_scope(
+    mod: Module, body: list, qual: str
+) -> list[tuple[ast.AST, str, str]]:
+    """One function body (or the module's top level, defs excluded):
+    returns flagged (node, qual, kind) write sites. A scope containing
+    os.replace/os.rename is the commit idiom and never flags."""
+    nodes: list[ast.AST] = []
+    for stmt in body:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue  # nested defs are their own scopes
+        nodes.extend(ast.walk(stmt))
+
+    assigns: dict[str, str] = {}
+    has_rename = False
+    writes: list[tuple[ast.AST, str]] = []
+    for node in nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            assigns[node.targets[0].id] = _src(mod, node.value)
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        canon = mod.symbols.canonical_of(node.func)
+        if d in _RENAMES or canon in _RENAMES:
+            has_rename = True
+            continue
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id == "open"
+            and node.args
+        ):
+            mode = _open_mode(node)
+            if mode is not None and mode.replace("b", "").replace(
+                "+", ""
+            ) == "w":
+                writes.append((node, "open"))
+        elif (d in _SAVERS or canon in _SAVERS) and node.args:
+            writes.append((node, "np.save"))
+    if has_rename:
+        return []
+    out = []
+    for node, kind in writes:
+        path_arg = node.args[0]
+        text = _path_text(mod, path_arg, assigns)
+        if any(k in text for k in _KEYWORDS):
+            out.append((node, qual, kind))
+    return out
+
+
+def _scan_module(mod: Module) -> list[Finding]:
+    flagged: list[tuple[ast.AST, str, str]] = []
+    flagged.extend(_scan_scope(mod, mod.tree.body, "<module>"))
+
+    def walk(body, prefix):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{stmt.name}"
+                flagged.extend(_scan_scope(mod, stmt.body, qual))
+                walk(stmt.body, f"{qual}.")
+            elif isinstance(stmt, ast.ClassDef):
+                walk(stmt.body, f"{stmt.name}.")
+
+    walk(mod.tree.body, "")
+    return [
+        Finding(
+            CHECKER,
+            CHECKER,
+            mod.relpath,
+            node.lineno,
+            qual,
+            f"state file written in place via {kind} with no os.replace/"
+            "os.rename in scope — a crash (or a kill -9 of the async "
+            "checkpoint writer) mid-write leaves a torn file where the "
+            "previous good version used to be. Write to a tmp name, "
+            "fsync, then commit with one atomic rename (the graph/wal.py "
+            "write_snapshot / training/checkpoint.py form), or suppress "
+            "with a reason",
+        )
+        for node, qual, kind in flagged
+    ]
+
+
+@register
+class DurableWriteChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            out.extend(_scan_module(mod))
+        return out

@@ -298,6 +298,181 @@ class ShardSupervisor:
                     pass
 
 
+class TrainerSupervisor:
+    """Supervise ONE durable trainer process (`tools/train.py`).
+
+    The trainer side of the shard story above: exit 0 means the run
+    reached its target step — done, no respawn. ANY other exit (crash,
+    OOM-kill, `kill -9`) respawns the trainer with `--resume` appended,
+    under the same exponential backoff + crash-loop cap as shards; the
+    respawned process restores the newest COMPLETE retained checkpoint
+    (euler_tpu/training/checkpoint.py) and continues bit-exactly, so a
+    trainer kill under live traffic is a non-event. Exit 3 (SIGTERM
+    preemption drain) is treated as done-for-now and NOT respawned —
+    preemption is an operator/scheduler decision, not a crash."""
+
+    DONE_CODES = (0, 3)
+
+    def __init__(
+        self,
+        train_args: list[str],
+        log_path: str,
+        max_restarts: int = 8,
+        backoff_s: float = 0.25,
+        backoff_max_s: float = 5.0,
+        healthy_uptime_s: float = 30.0,
+        poll_s: float = 0.1,
+        env: dict | None = None,
+    ):
+        self.train_args = list(train_args)
+        self.log_path = log_path
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.healthy_uptime_s = float(healthy_uptime_s)
+        self.poll_s = float(poll_s)
+        self.env = dict(env) if env else None
+        self.proc: subprocess.Popen | None = None
+        self.restarts = 0
+        self.exit_code: int | None = None
+        self.failed = False  # crash loop exceeded max_restarts
+        self._window_restarts = 0
+        self._started_at = 0.0
+        self._next_spawn_at = 0.0
+        self._stop = threading.Event()
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._monitor: threading.Thread | None = None
+
+    def _spawn(self, resume: bool) -> None:
+        # callers hold self._lock (same discipline as _Shard._spawn)
+        argv = list(self.train_args)
+        if resume and "--resume" not in argv:
+            argv.append("--resume")
+        cmd = [sys.executable, "-m", "euler_tpu.tools.train", *argv]
+        env = dict(os.environ if self.env is None else self.env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log = open(self.log_path, "ab")
+        try:
+            # graftlint: disable=lock-unguarded-write -- callers hold self._lock around _spawn
+            self.proc = subprocess.Popen(
+                cmd, stdout=log, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True,
+            )
+        finally:
+            log.close()
+        # graftlint: disable=lock-unguarded-write -- callers hold self._lock around _spawn
+        self._started_at = time.monotonic()
+
+    def start(self, resume: bool = False) -> "TrainerSupervisor":
+        with self._lock:
+            self._spawn(resume)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="trainer-supervisor"
+        )
+        self._monitor.start()
+        return self
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            with self._lock:
+                p = self.proc
+                if self.failed or self._done.is_set() or p is None:
+                    return
+                rc = p.poll()
+                if rc is None:
+                    if (
+                        self._window_restarts
+                        and now - self._started_at > self.healthy_uptime_s
+                    ):
+                        self._window_restarts = 0
+                elif rc in self.DONE_CODES:
+                    self.exit_code = rc
+                    self._done.set()
+                    return
+                elif self._next_spawn_at == 0.0:
+                    self._window_restarts += 1
+                    if self._window_restarts > self.max_restarts:
+                        self.failed = True
+                        self.exit_code = rc
+                        print(
+                            f"# supervisor: trainer crash-looped past "
+                            f"max_restarts={self.max_restarts}; giving up"
+                            f" (exit {rc})",
+                            file=sys.stderr, flush=True,
+                        )
+                        self._done.set()
+                        return
+                    pause = min(
+                        self.backoff_s * 2 ** (self._window_restarts - 1),
+                        self.backoff_max_s,
+                    )
+                    self._next_spawn_at = now + pause
+                elif now >= self._next_spawn_at:
+                    self._next_spawn_at = 0.0
+                    self.restarts += 1
+                    print(
+                        f"# supervisor: restarting trainer with --resume"
+                        f" (exit {rc}, restart #{self.restarts})",
+                        file=sys.stderr, flush=True,
+                    )
+                    self._spawn(resume=True)
+            self._stop.wait(self.poll_s)
+
+    def kill(self, sig: int = signal.SIGKILL) -> None:
+        """Chaos entry point: the seeded `kill -9` the resume proof
+        injects."""
+        with self._lock:
+            p = self.proc
+        if p is not None and p.poll() is None:
+            os.kill(p.pid, sig)
+
+    def wait(self, timeout_s: float = 300.0) -> bool:
+        """Block until the run completes (exit 0/3) or crash-loops out;
+        True iff the trainer finished rather than failed."""
+        if not self._done.wait(timeout_s):
+            return False
+        return not self.failed
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "alive": bool(
+                    self.proc is not None and self.proc.poll() is None
+                ),
+                "restarts": self.restarts,
+                "failed": self.failed,
+                "done": self._done.is_set(),
+                "exit_code": self.exit_code,
+                "pid": getattr(self.proc, "pid", None),
+            }
+
+    def stop(self, term_timeout_s: float = 10.0) -> None:
+        """Stop supervising, then SIGTERM the trainer (it drains: final
+        checkpoint flush, exit 3); SIGKILL a straggler."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        with self._lock:
+            p = self.proc
+        if p is None:
+            return
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        try:
+            p.wait(timeout=term_timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+                p.wait(timeout=5.0)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", required=True)

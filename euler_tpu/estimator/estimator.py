@@ -35,6 +35,12 @@ class EstimatorConfig:
     momentum: float = 0.9
     log_steps: int = 20
     checkpoint_steps: int = 0  # 0 = only at end
+    # retained atomic checkpoints (euler_tpu/training/checkpoint.py):
+    # save() commits step-numbered ckpt_<step>/ dirs and keeps this many
+    # complete ones — a crash mid-save can never lose the previous good
+    # state. restore() picks the newest COMPLETE one (legacy single-path
+    # Orbax "ckpt" dirs still restore).
+    keep_checkpoints: int = 3
     seed: int = 0
     # profiling (BaseEstimator(profiling=True) parity, base_estimator.py:
     # 130-133): when set, a jax.profiler trace of `profile_steps` steps is
@@ -340,6 +346,10 @@ class Estimator:
         self._init_params = init_params
         self.opt_state = None
         self.step = 0
+        # losses fetched by the most recent train() — populated even
+        # when the loop raises (try/finally drain), so a crash surfaces
+        # the trajectory observed so far
+        self.last_losses: list = []
         self.tx = make_optimizer(self.cfg)
         # models may declare extra rng collections (e.g. VGAE's "reparam")
         self._rng_names = tuple(getattr(model, "rng_collections", ()))
@@ -549,52 +559,94 @@ class Estimator:
         # long run pins an unbounded number of small device buffers
         drain_every = 4096
         profiling = False
-        for _ in range(steps):
-            if (
-                self.cfg.profile_dir
-                and not getattr(self, "_profiled", False)
-                and self.step >= self.cfg.profile_start_step
-            ):
-                jax.profiler.start_trace(self.cfg.profile_dir)
-                profiling = True
-                profile_stop = self.step + self.cfg.profile_steps
-                self._profiled = True
-            batch = self._next_batch(1)
-            self.params, self.opt_state, loss, metric = step_fn(
-                self.params, self.opt_state, self._rngs(self.step), *batch
-            )
-            self.step += 1
-            if profiling and self.step >= profile_stop:
-                jax.block_until_ready(loss)
-                jax.profiler.stop_trace()
-                profiling = False
-            if log and self.step % self.cfg.log_steps == 0:
-                loss_v = float(loss)
-                dt = time.time() - t0
-                print(
-                    f"step {self.step}: loss={loss_v:.4f} "
-                    f"metric={float(metric):.4f} ({self.step / dt:.1f} it/s)"
+        try:
+            for _ in range(steps):
+                if (
+                    self.cfg.profile_dir
+                    and not getattr(self, "_profiled", False)
+                    and self.step >= self.cfg.profile_start_step
+                ):
+                    jax.profiler.start_trace(self.cfg.profile_dir)
+                    profiling = True
+                    profile_stop = self.step + self.cfg.profile_steps
+                    self._profiled = True
+                batch = self._next_batch(1)
+                self.params, self.opt_state, loss, metric = step_fn(
+                    self.params, self.opt_state, self._rngs(self.step), *batch
                 )
-            # keep losses on device — a float() here would force a blocking
-            # device→host round trip every step and serialize the pipeline
-            history.append(loss)
-            if len(history) >= drain_every:
-                fetched.extend(np.asarray(jnp.stack(history)).tolist())
-                history = []
-            if (
-                self.cfg.checkpoint_steps
-                and self.step % self.cfg.checkpoint_steps == 0
-            ):
-                self.save()
-        if profiling:  # loop ended inside the profile window
-            jax.block_until_ready(self.params)
-            jax.profiler.stop_trace()
-        if save:
-            self.save()
-        # batched fetch of the remaining step losses (one transfer, not N)
-        if history:
-            fetched.extend(np.asarray(jnp.stack(history)).tolist())
+                self.step += 1
+                if profiling and self.step >= profile_stop:
+                    jax.block_until_ready(loss)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                if log and self.step % self.cfg.log_steps == 0:
+                    loss_v = float(loss)
+                    dt = time.time() - t0
+                    print(
+                        f"step {self.step}: loss={loss_v:.4f} "
+                        f"metric={float(metric):.4f} ({self.step / dt:.1f} it/s)"
+                    )
+                # keep losses on device — a float() here would force a
+                # blocking device→host round trip every step and
+                # serialize the pipeline
+                history.append(loss)
+                if len(history) >= drain_every:
+                    fetched.extend(np.asarray(jnp.stack(history)).tolist())
+                    history = []
+                if (
+                    self.cfg.checkpoint_steps
+                    and self.step % self.cfg.checkpoint_steps == 0
+                ):
+                    self.save()
+        finally:
+            # a raising loop (dead shard, OOM, poisoned batch) must still
+            # surface the losses fetched so far and leave a best-effort
+            # checkpoint — previously both were silently dropped
+            history, fetched = self._finish_train(
+                history, fetched, profiling, save
+            )
         return fetched
+
+    def _finish_train(self, history, fetched, profiling, save, concat=False):
+        """Shared train-loop epilogue, run from a `finally`: stop a live
+        profiler trace, drain the on-device loss history, publish the
+        losses fetched so far on `self.last_losses`, and save. When an
+        exception is unwinding, the drain and the save are best-effort
+        (the original error stays the one surfaced); on the clean path a
+        save failure still raises."""
+        import sys as _sys
+
+        exc_live = _sys.exc_info()[0] is not None
+        if profiling:
+            try:
+                jax.block_until_ready(self.params)
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        if history:
+            try:
+                joined = jnp.concatenate(history) if concat else jnp.stack(
+                    history
+                )
+                fetched.extend(np.asarray(joined).tolist())
+                history = []
+            except Exception:
+                if not exc_live:
+                    raise
+        self.last_losses = list(fetched)
+        if save and self.params is not None:
+            if exc_live:
+                try:
+                    self.save()
+                except Exception as e:
+                    print(
+                        f"# estimator: best-effort checkpoint after a "
+                        f"raising train loop failed: {e!r}",
+                        file=_sys.stderr,
+                    )
+            else:
+                self.save()
+        return history, fetched
 
     def _train_scan(self, steps: int, k: int, log: bool, save: bool):
         """Driver for steps_per_call>1: each batch_fn() item is a K-stacked
@@ -608,64 +660,70 @@ class Estimator:
         drain_every = max(4096 // k, 1)
         calls, remainder = divmod(steps, k)
         profiling = False
-        for _ in range(calls):
-            if (
-                self.cfg.profile_dir
-                and not getattr(self, "_profiled", False)
-                and self.step >= self.cfg.profile_start_step
-            ):
-                jax.profiler.start_trace(self.cfg.profile_dir)
-                profiling = True
-                profile_stop = self.step + max(self.cfg.profile_steps, k)
-                self._profiled = True
-            batch = self._next_batch(k)
-            rngs = self._rngs_stacked(self.step, k)
-            self.params, self.opt_state, losses, metric = step_fn(
-                self.params, self.opt_state, rngs, *batch
-            )
-            self.step += k
-            if profiling and self.step >= profile_stop:
-                jax.block_until_ready(losses)
+        try:
+            for _ in range(calls):
+                if (
+                    self.cfg.profile_dir
+                    and not getattr(self, "_profiled", False)
+                    and self.step >= self.cfg.profile_start_step
+                ):
+                    jax.profiler.start_trace(self.cfg.profile_dir)
+                    profiling = True
+                    profile_stop = self.step + max(self.cfg.profile_steps, k)
+                    self._profiled = True
+                batch = self._next_batch(k)
+                rngs = self._rngs_stacked(self.step, k)
+                self.params, self.opt_state, losses, metric = step_fn(
+                    self.params, self.opt_state, rngs, *batch
+                )
+                self.step += k
+                if profiling and self.step >= profile_stop:
+                    jax.block_until_ready(losses)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                if log and self.step % max(self.cfg.log_steps, 1) < k:
+                    dt = time.time() - t0
+                    print(
+                        f"step {self.step}: loss={float(losses[-1]):.4f} "
+                        f"metric={float(metric):.4f} "
+                        f"({self.step / dt:.1f} it/s)"
+                    )
+                history.append(losses)
+                if len(history) >= drain_every:
+                    fetched.extend(
+                        np.asarray(jnp.concatenate(history)).tolist()
+                    )
+                    history = []
+                if (
+                    self.cfg.checkpoint_steps
+                    and self.step % self.cfg.checkpoint_steps < k
+                ):
+                    self.save()
+            if profiling:
+                jax.block_until_ready(self.params)
                 jax.profiler.stop_trace()
                 profiling = False
-            if log and self.step % max(self.cfg.log_steps, 1) < k:
-                dt = time.time() - t0
-                print(
-                    f"step {self.step}: loss={float(losses[-1]):.4f} "
-                    f"metric={float(metric):.4f} ({self.step / dt:.1f} it/s)"
+            if remainder:
+                single = self._train_step()
+                item = (
+                    (self._flow_keys(self.step, remainder),)
+                    if self._device_flow is not None
+                    else self._put(self.batch_fn(), stacked=True)
                 )
-            history.append(losses)
-            if len(history) >= drain_every:
-                fetched.extend(
-                    np.asarray(jnp.concatenate(history)).tolist()
-                )
-                history = []
-            if (
-                self.cfg.checkpoint_steps
-                and self.step % self.cfg.checkpoint_steps < k
-            ):
-                self.save()
-        if profiling:
-            jax.block_until_ready(self.params)
-            jax.profiler.stop_trace()
-        if remainder:
-            single = self._train_step()
-            item = (
-                (self._flow_keys(self.step, remainder),)
-                if self._device_flow is not None
-                else self._put(self.batch_fn(), stacked=True)
+                for i in range(remainder):
+                    batch = jax.tree_util.tree_map(lambda x: x[i], item)
+                    self.params, self.opt_state, loss, _ = single(
+                        self.params, self.opt_state, self._rngs(self.step),
+                        *batch,
+                    )
+                    self.step += 1
+                    history.append(loss[None])
+        finally:
+            # same contract as train(): a raising loop still drains the
+            # fetched losses and leaves a best-effort checkpoint
+            history, fetched = self._finish_train(
+                history, fetched, profiling, save, concat=True
             )
-            for i in range(remainder):
-                batch = jax.tree_util.tree_map(lambda x: x[i], item)
-                self.params, self.opt_state, loss, _ = single(
-                    self.params, self.opt_state, self._rngs(self.step), *batch
-                )
-                self.step += 1
-                history.append(loss[None])
-        if save:
-            self.save()
-        if history:
-            fetched.extend(np.asarray(jnp.concatenate(history)).tolist())
         return fetched[:steps]
 
     def _shared_apply_jit(self, kind: str, build):
@@ -759,24 +817,70 @@ class Estimator:
             remaining -= chunk
         return results
 
-    # -- checkpointing (Orbax) -------------------------------------------
+    # -- checkpointing ---------------------------------------------------
 
-    def save(self):
-        import orbax.checkpoint as ocp
+    def save(self) -> str:
+        """Commit one retained atomic checkpoint (`ckpt_<step>/` under
+        model_dir: tmp + fsync + rename + COMMIT marker, keep-N GC).
 
-        path = os.path.join(os.path.abspath(self.cfg.model_dir), "ckpt")
-        ckpt = ocp.PyTreeCheckpointer()
-        ckpt.save(
-            path,
-            {
-                "params": self.params,
-                "opt_state": self.opt_state,
-                "step": self.step,
-            },
-            force=True,
+        The old behavior — overwrite ONE fixed Orbax path with
+        force=True — meant a kill -9 mid-save destroyed the only
+        checkpoint in existence; now the previous complete checkpoint
+        survives any crash point of this write. Returns the committed
+        path."""
+        from euler_tpu.training.checkpoint import CheckpointStore
+
+        self._ensure_init()
+        p_leaves, _ = jax.tree_util.tree_flatten(self.params)
+        o_leaves, _ = jax.tree_util.tree_flatten(self.opt_state)
+        store = CheckpointStore(
+            self.cfg.model_dir, keep=self.cfg.keep_checkpoints
+        )
+        return store.save_leaves(
+            self.step,
+            [np.asarray(jax.device_get(x)) for x in p_leaves],
+            [np.asarray(jax.device_get(x)) for x in o_leaves],
+            {"seed": int(self.cfg.seed)},
         )
 
-    def restore(self):
+    def restore(self) -> bool:
+        """Restore the newest COMPLETE retained checkpoint (torn dirs —
+        a crash mid-save — are invisible by construction), falling back
+        to a legacy single-path Orbax `ckpt` dir for pre-retained
+        model_dirs."""
+        from euler_tpu.training.checkpoint import CheckpointStore
+
+        store = CheckpointStore(
+            self.cfg.model_dir, keep=self.cfg.keep_checkpoints
+        )
+        step = store.latest_step()
+        if step is not None:
+            self._ensure_init()
+            ckpt = store.load(step)
+
+            def onto(saved, live):
+                leaves, tdef = jax.tree_util.tree_flatten(live)
+                if len(saved) != len(leaves):
+                    raise ValueError(
+                        f"checkpoint ckpt_{step:012d} carries {len(saved)} "
+                        f"leaves where the live tree has {len(leaves)} — "
+                        "model/optimizer config drifted from the saved run"
+                    )
+                put = [
+                    jax.device_put(s, x.sharding)
+                    if isinstance(x, jax.Array)
+                    else jnp.asarray(s)
+                    for s, x in zip(saved, leaves)
+                ]
+                return jax.tree_util.tree_unflatten(tdef, put)
+
+            self.params = onto(ckpt["params"], self.params)
+            self.opt_state = onto(ckpt["opt_state"], self.opt_state)
+            self.step = int(ckpt["step"])
+            return True
+        return self._restore_legacy_orbax()
+
+    def _restore_legacy_orbax(self) -> bool:
         import orbax.checkpoint as ocp
 
         path = os.path.join(os.path.abspath(self.cfg.model_dir), "ckpt")

@@ -75,8 +75,16 @@ class GraphMeta:
         return cls(**d)
 
     def save(self, directory: str) -> None:
-        with open(os.path.join(directory, "euler.meta.json"), "w") as f:
+        # tmp + fsync + atomic rename (the graph/wal.py state-file
+        # idiom, enforced by graftlint durable-write): a crash mid-save
+        # must leave the previous meta readable, never a torn JSON
+        final = os.path.join(directory, "euler.meta.json")
+        tmp = f"{final}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(self.to_dict(), f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
 
     @classmethod
     def load(cls, directory: str) -> "GraphMeta":

@@ -194,7 +194,13 @@ class InferenceRuntime:
         string; default: re-restore the current model_dir, picking up a
         newer checkpoint written in place) or from a `params` pytree,
         warms every bucket's jitted program against it, then publishes it
-        with one reference assignment. The dispatch path is never paused:
+        with one reference assignment. Only COMPLETE checkpoints are
+        candidates: the restore resolves the newest retained
+        `ckpt_<step>/` whose COMMIT marker committed
+        (training/checkpoint.py), so a swap racing a trainer's in-flight
+        save loads the previous good checkpoint instead of a torn one —
+        and a model_dir holding ONLY torn state raises instead of
+        swapping. The dispatch path is never paused:
         requests in flight — even mid-chunk — finish on the engine they
         started on, and the first request after the publish runs the new
         checkpoint on already-compiled programs."""

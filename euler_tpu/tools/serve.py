@@ -105,26 +105,26 @@ def serve_fleet(args) -> list:
     return servers
 
 
-def _ckpt_mtime(model_dir: str) -> float:
-    path = os.path.join(os.path.abspath(model_dir), "ckpt")
-    try:
-        # the checkpoint dir's newest entry: orbax writes a fresh tree on
-        # every save, so any rewrite moves this forward
-        return max(
-            os.path.getmtime(os.path.join(path, e))
-            for e in os.listdir(path)
-        )
-    except (OSError, ValueError):
-        return 0.0
+def _ckpt_signature(model_dir: str) -> tuple:
+    """Change token for the reload watcher: moves ONLY when a new
+    COMPLETE checkpoint commits (training/checkpoint.py COMMIT marker),
+    so a poll landing mid-write — a trainer still fsync'ing a
+    `ckpt_*.tmp-*` dir, or a torn dir left by a kill -9 — can never
+    trigger a swap onto a torn checkpoint. Legacy single-path Orbax
+    dirs keep the old newest-entry-mtime behavior."""
+    from euler_tpu.training.checkpoint import watch_signature
+
+    return watch_signature(model_dir)
 
 
 def watch_reload(servers, model_dir: str, stop_event, poll_s: float):
-    """Hot-swap every replica whenever a new checkpoint lands under
-    model_dir — the serving fleet never restarts for a deploy."""
-    last = _ckpt_mtime(model_dir)
+    """Hot-swap every replica whenever a new COMPLETE checkpoint lands
+    under model_dir — the serving fleet never restarts for a deploy,
+    and never loads a half-written one."""
+    last = _ckpt_signature(model_dir)
     while not stop_event.wait(poll_s):
-        now = _ckpt_mtime(model_dir)
-        if now <= last:
+        now = _ckpt_signature(model_dir)
+        if now == last:
             continue
         last = now
         for server in servers:

@@ -1,0 +1,15 @@
+from euler_tpu.training.checkpoint import (  # noqa: F401
+    CheckpointStore,
+    is_complete,
+    latest_complete,
+    watch_signature,
+)
+from euler_tpu.training.session import (  # noqa: F401
+    AnomalyError,
+    HungStepError,
+    ResumableSource,
+    SessionConfig,
+    TrainingError,
+    TrainingSession,
+    resumable_node_batches,
+)

@@ -1,0 +1,46 @@
+"""durable-write known-bad fixture: state files overwritten in place.
+
+Every write here names checkpoint/snapshot/cache state and has no
+os.replace/os.rename in scope — the pre-PR-10 Estimator.save shape,
+where a kill -9 mid-write destroys the only good copy."""
+
+import json
+import os
+import threading
+
+import numpy as np
+
+
+class CkptWriter:
+    def __init__(self, root):
+        self.root = root
+
+    def save_meta(self, meta):
+        # BAD: checkpoint metadata overwritten in place
+        with open(os.path.join(self.root, "ckpt_meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def save_arrays(self, arr):
+        # BAD: the checkpoint payload itself, same in-place overwrite
+        np.save(os.path.join(self.root, "checkpoint.npy"), arr)
+
+
+def snapshot_writer(state, path):
+    # BAD even through a local name: the path text resolves to a
+    # snapshot file, and this runs on the async writer thread below
+    snap = path + "/snapshot.json"
+    with open(snap, "w") as f:
+        json.dump(state, f)
+
+
+def start_async_writer(state):
+    t = threading.Thread(target=snapshot_writer, args=(state, "/tmp"))
+    t.start()
+    return t
+
+
+def fine_report(rows, path):
+    # NOT flagged: no state-file keyword — scratch outputs are allowed
+    # to be torn
+    with open(path + "/report.txt", "w") as f:
+        f.write("\n".join(rows))

@@ -1,0 +1,40 @@
+"""durable-write fixed form: tmp + fsync + one atomic rename.
+
+The graph/wal.py write_snapshot / training/checkpoint.py idiom — a
+crash at any point leaves either the previous good file or the new one,
+never a torn mix."""
+
+import json
+import os
+
+import numpy as np
+
+
+class CkptWriter:
+    def __init__(self, root):
+        self.root = root
+
+    def save_meta(self, meta):
+        final = os.path.join(self.root, "ckpt_meta.json")
+        tmp = f"{final}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+
+    def save_arrays(self, arr):
+        final = os.path.join(self.root, "checkpoint.npy")
+        tmp = final + ".tmp.npy"  # np.save appends .npy to bare names
+        np.save(tmp, arr)
+        os.replace(tmp, final)
+
+
+def snapshot_writer(state, path):
+    snap = path + "/snapshot.json"
+    tmp = snap + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, snap)

@@ -102,6 +102,25 @@ def test_bench_smoke_emits_final_json_line():
     assert row["durability_fsync_overhead_x"] >= 0.8, row
     assert row["durability_snapshot_ms"] > 0
     assert row["durability_recovery_ms"] > 0
+    # the durable-training resume lane (ISSUE 10) must not silently
+    # vanish: the sync-vs-async save stall A/B (the cadence/step-time
+    # tradeoff), resume-to-first-step latency, retained-checkpoint disk
+    # footprint, and the train-2N == train-N + resume-N bit-parity
+    # oracle all ride the artifact
+    assert row["resume"] is True, row
+    assert row["resume_bit_parity"] is True, row
+    assert row["resume_save_sync_ms"] > 0
+    assert row["resume_save_async_stall_ms"] >= 0
+    # the async writer exists to take the commit off the step path; the
+    # stall it leaves (host snapshot + enqueue) must not exceed the
+    # full inline commit (allow noise)
+    assert (
+        row["resume_save_async_stall_ms"]
+        <= row["resume_save_sync_ms"] * 1.5
+    ), row
+    assert row["resume_to_first_step_ms"] > 0
+    assert row["resume_ckpt_bytes"] > 0
+    assert row["resume_retained_ckpts"] >= 1
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline

@@ -99,6 +99,12 @@ def test_gate_covers_the_package():
         # territory, plus the wire-wal-drift lockstep gate below
         "euler_tpu/graph/wal.py",
         "euler_tpu/distributed/supervisor.py",
+        # the durable-training lane (ISSUE 10): the async checkpoint
+        # writer + watchdog threads and the atomic state-file commits —
+        # lock-discipline and durable-write territory
+        "euler_tpu/training/session.py",
+        "euler_tpu/training/checkpoint.py",
+        "euler_tpu/tools/train.py",
         "bench.py",
     ):
         assert must in rels, f"{must} escaped the lint gate"
@@ -167,6 +173,29 @@ def test_unbounded_cache_fixed_form_clean():
     # budget), the epoch reset-by-rebind, and the exempt Counter /
     # WeakKeyDictionary forms
     assert _check(_fixture_project("cache_good.py"), "unbounded-cache") == []
+
+
+def test_durable_write_fixture_trips():
+    findings = _check(_fixture_project("durable_bad.py"), "durable-write")
+    ids = _ids(findings)
+    assert ids["durable-write"] == 3, findings
+    symbols = {f.symbol for f in findings}
+    # json-dump via open, np.save, and the path-through-a-local-name form
+    # (the async-writer thread target) are all covered
+    assert symbols == {
+        "CkptWriter.save_meta",
+        "CkptWriter.save_arrays",
+        "snapshot_writer",
+    }, findings
+
+
+def test_durable_write_fixed_form_clean():
+    # durable_good.py mirrors the shipped idiom: tmp + fsync + one
+    # atomic os.replace/os.rename (wal.write_snapshot /
+    # training/checkpoint.py CheckpointStore.save_leaves)
+    assert _check(
+        _fixture_project("durable_good.py"), "durable-write"
+    ) == []
 
 
 def test_determinism_fixture_trips():
