@@ -850,6 +850,74 @@ def _resume_lane(smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _analytics_lane(smoke: bool) -> dict:
+    """Whole-graph analytics lane (ISSUE 12; EULER_BENCH_ANALYTICS=0
+    opt-out): PageRank BSP sweep rate over the 2-shard engine, frontier
+    exchange bytes, the incremental-vs-full recompute speedup after a
+    live publish, and the `analytics_bit_parity` oracle — 1-shard and
+    2-shard runs (and the incremental rerun) must agree bit-for-bit."""
+    from euler_tpu.analytics import (
+        WholeGraphEngine,
+        pagerank,
+        rerun_incremental,
+    )
+    from euler_tpu.distributed.writer import GraphWriter
+    from euler_tpu.graph import Graph
+
+    n = 300 if smoke else 3000
+    nodes = [
+        {"id": i, "type": 0, "weight": 1.0, "features": []}
+        for i in range(1, n + 1)
+    ]
+    edges = [
+        {"src": s, "dst": (s + off) % n + 1, "type": off % 2,
+         "weight": float(1 + (s + off) % 4), "features": []}
+        for s in range(1, n + 1)
+        for off in (1, 3, 7)
+    ]
+    data = {"nodes": nodes, "edges": edges}
+    g2 = Graph.from_json(data, num_partitions=2)
+    eng = WholeGraphEngine(g2)
+    t0 = time.perf_counter()
+    r2 = pagerank(g2, engine=eng, max_iters=50, tol=1e-10)
+    sweep_s = time.perf_counter() - t0
+    r1 = pagerank(Graph.from_json(data, num_partitions=1), max_iters=50,
+                  tol=1e-10)
+    parity = np.array_equal(
+        r1.by_id()[1].view(np.uint64), r2.by_id()[1].view(np.uint64)
+    )
+    # live publish, then incremental replay vs from-scratch at the new
+    # epoch — parity extends to the rerun, speedup is wall-clock
+    w = GraphWriter(g2)
+    w.upsert_edges([5, 9], [12, max(n // 2, 13)], [0, 1], [9.0, 3.5])
+    pub = w.publish()
+    t0 = time.perf_counter()
+    r_full = pagerank(g2, max_iters=50, tol=1e-10)
+    t_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r_inc = rerun_incremental(g2, r2, publish=pub, engine=eng)
+    t_inc = time.perf_counter() - t0
+    parity = parity and np.array_equal(
+        r_full.values.view(np.uint64), r_inc.values.view(np.uint64)
+    )
+    return {
+        "analytics": True,
+        "analytics_bit_parity": bool(parity),
+        "analytics_pagerank_sweeps_per_sec": round(
+            r2.iterations / max(sweep_s, 1e-9), 2
+        ),
+        "analytics_exchange_bytes": int(r2.stats["exchange_bytes"]),
+        "analytics_incremental_speedup_x": round(
+            t_full / max(t_inc, 1e-9), 2
+        ),
+        "analytics_rows_recomputed_ratio": round(
+            r_inc.stats["rows_recomputed"]
+            / max(r_full.stats["rows_recomputed"], 1),
+            4,
+        ),
+    }
+
+
 def run(platform: str) -> tuple[float, dict]:
     from euler_tpu.dataflow import SageDataFlow
     from euler_tpu.datasets.synthetic import random_graph
@@ -1017,6 +1085,18 @@ def run(platform: str) -> tuple[float, dict]:
 
             traceback.print_exc()
             extra.update({"resume": False, "resume_error": repr(e)[:300]})
+    # whole-graph analytics lane (ISSUE 12) — PageRank sweep rate,
+    # exchange bytes, incremental-vs-full speedup, bit-parity oracle
+    if os.environ.get("EULER_BENCH_ANALYTICS", "1") != "0":
+        try:
+            extra.update(_analytics_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update(
+                {"analytics": False, "analytics_error": repr(e)[:300]}
+            )
     probe = _probe_meta()
     if probe:
         extra["probe"] = probe

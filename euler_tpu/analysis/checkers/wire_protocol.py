@@ -75,6 +75,9 @@ DEFAULT_DOMAINS = (
             # the streaming-mutation writer (ISSUE 8): upsert/delete/
             # publish verbs ride the same protocol
             "euler_tpu/distributed/writer.py",
+            # whole-graph analytics (ISSUE 12): frontier_exchange rides
+            # the graph protocol from the BSP primitives
+            "euler_tpu/analytics/primitives.py",
         ),
         servers=("euler_tpu/distributed/service.py",),
     ),
@@ -236,16 +239,19 @@ def check_domain(project: Project, domain: WireDomain) -> list[Finding]:
     if not client_mods or not server_mods:
         return []  # domain not in this project slice — nothing to check
 
+    # tables key by (module, name): several client modules legitimately
+    # declare a module-level WIRE_VERBS (query planner, analytics
+    # primitives) and must all count toward the declared union
     for m in client_mods:
         for verb, (line, qual) in extract_sent(m).sites.items():
             sent.setdefault(verb, (m.relpath, line, qual))
         for name, (vals, line) in extract_tables(m).items():
-            client_tables[name] = (m.relpath, vals, line)
+            client_tables[f"{m.relpath}:{name}"] = (m.relpath, vals, line)
     for m in server_mods:
         for verb, (line, qual) in extract_handled(m).sites.items():
             handled.setdefault(verb, (m.relpath, line, qual))
         for name, (vals, line) in extract_tables(m).items():
-            server_tables[name] = (m.relpath, vals, line)
+            server_tables[f"{m.relpath}:{name}"] = (m.relpath, vals, line)
 
     for verb in sorted(set(sent) - set(handled)):
         if verb in domain.allow_unhandled:

@@ -1,0 +1,23 @@
+"""Whole-graph offline analytics (ISSUE 12): the third workload class
+next to training and serving — bulk-synchronous PageRank / label
+propagation / connected components over the sharded CSR partitions,
+plus KG-embedding sweeps with retained checkpoints. Every run pins one
+published graph epoch and is bit-deterministic across shard counts and
+local/remote execution."""
+
+from euler_tpu.analytics.algorithms import (  # noqa: F401
+    AnalyticsResult,
+    connected_components,
+    label_propagation,
+    pagerank,
+    rerun_incremental,
+)
+from euler_tpu.analytics.primitives import (  # noqa: F401
+    ShardedFrontier,
+    WholeGraphEngine,
+    broadcast,
+    map_shards,
+    reduce_messages,
+    reduce_scatter_frontier,
+)
+from euler_tpu.analytics.sweeps import run_kg_sweep  # noqa: F401

@@ -1,0 +1,487 @@
+"""Whole-graph iterative algorithms over the BSP primitives.
+
+PageRank (weighted, damped, tolerance stop), label propagation
+(weighted majority vote) and connected components (iterative min-label)
+— each bit-deterministic across shard counts AND across local/remote
+execution, because every reduction runs through the canonical order in
+``primitives.reduce_messages`` (sorted segment reductions, never
+set-iteration).
+
+The PageRank variant deliberately skips dangling-mass redistribution
+(r = (1-d)/N + d·Σ w_norm·r[src]): redistribution couples every row to
+every dangling row globally, which would make the incremental dirty set
+the whole graph after one step. Without it each row depends only on its
+in-neighbors, so incremental recompute stays local to the mutation.
+
+Incremental recompute (``rerun_incremental``) is MEMOIZED REPLAY, not
+warm-starting: the from-scratch run records its per-iteration
+trajectory; the rerun replays the same iteration schedule, recomputing
+only rows whose inputs could differ (the publish result's mutated-row
+set, propagated one out-edge hop per iteration) and copying every other
+row from the recorded trajectory. The rerun therefore converges to the
+SAME fixed point with the SAME iteration count and bit pattern as a
+from-scratch run at the new epoch — pinned by tests/test_analytics.py —
+while ``stats["rows_recomputed"]`` proves it touched only the mutated
+region.
+
+Long runs can checkpoint the frontier through the PR-10 retained
+checkpoint store (``checkpoint_dir``/``checkpoint_every``): a shard
+death mid-sweep surfaces as the usual typed RpcError, and the rerun
+with ``resume=True`` continues from the last committed frontier —
+bit-identical to an uninterrupted run, because iteration math never
+depends on wall clock or history.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from euler_tpu.analytics.primitives import (
+    WholeGraphEngine,
+    _ragged_take,
+)
+from euler_tpu.training.checkpoint import CheckpointStore
+
+
+@dataclass
+class AnalyticsResult:
+    """One pinned-epoch analytics run: values are f64 per global row
+    (shard-major); ``by_id()`` is the shard-count-independent view."""
+
+    algo: str
+    values: np.ndarray
+    node_ids: np.ndarray
+    offsets: np.ndarray
+    epoch_pin: tuple
+    iterations: int
+    converged: bool
+    trajectory: list | None
+    stats: dict = field(default_factory=dict)
+    params: dict = field(default_factory=dict)
+
+    def by_id(self):
+        order = np.argsort(self.node_ids, kind="stable")
+        return self.node_ids[order], np.asarray(self.values)[order]
+
+    def labels_by_id(self):
+        ids, vals = self.by_id()
+        return ids, vals.astype(np.int64)
+
+
+def _bits(v: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(v, np.float64)).view(np.uint64)
+
+
+def _out_neighbors(engine, rows: np.ndarray) -> np.ndarray:
+    """Global rows reachable over one out-edge from `rows` — the dirty
+    set's per-iteration propagation front."""
+    if len(rows) == 0:
+        return np.empty(0, np.int64)
+    starts = engine._out_indptr[rows]
+    lens = engine._out_indptr[rows + 1] - starts
+    return np.unique(engine._out_dst[_ragged_take(starts, lens)])
+
+
+def _id_ranks(engine) -> np.ndarray:
+    """Initial label per row: the node id's rank in the global sorted
+    id order — dense, and identical per NODE for every shard count."""
+    rank = np.empty(engine.num_rows, np.int64)
+    rank[np.argsort(engine.node_ids, kind="stable")] = np.arange(
+        engine.num_rows, dtype=np.int64
+    )
+    return rank.astype(np.float64)
+
+
+def _local_rows(engine, p: int, dirty: np.ndarray | None):
+    if dirty is None:
+        return None
+    lo, hi = engine.offsets[p], engine.offsets[p + 1]
+    return dirty[(dirty >= lo) & (dirty < hi)] - lo
+
+
+def _norm_weights(engine, p: int) -> np.ndarray:
+    part = engine.parts[p]
+    if "wn" not in part:
+        denom = engine.out_w[part["src"]]
+        part["wn"] = np.divide(
+            part["w"], denom,
+            out=np.zeros_like(part["w"]), where=denom > 0,
+        )
+    return part["wn"]
+
+
+# ---------------------------------------------------------------------------
+# per-iteration kernels: (engine, cur, dirty_global|None, base|None) → new
+# ---------------------------------------------------------------------------
+
+
+def _step_pagerank(engine, cur, dirty, base, damping):
+    n = engine.num_rows
+    teleport = (1.0 - damping) / n
+    if base is None:
+        new = np.full(n, teleport, np.float64)
+    else:
+        new = base
+        new[dirty] = teleport
+    for p in range(engine.num_shards):
+        local = _local_rows(engine, p, dirty)
+        if local is not None and len(local) == 0:
+            continue
+        rows, eidx = engine.gather_edges(p, local)
+        vals = engine.contrib(p, eidx, cur, _norm_weights(engine, p))
+        u, v, _ = engine.exchange(p, rows, eidx, vals, "sum")
+        new[u + engine.offsets[p]] += damping * v
+    return new
+
+
+def _step_label_prop(engine, cur, dirty, base):
+    if base is None:
+        new = cur.copy()
+    else:
+        new = base
+        new[dirty] = cur[dirty]  # rows with no votes keep their label
+    for p in range(engine.num_shards):
+        local = _local_rows(engine, p, dirty)
+        if local is not None and len(local) == 0:
+            continue
+        rows, eidx = engine.gather_edges(p, local)
+        part = engine.parts[p]
+        keys = cur[part["src"][eidx]].astype(np.int64)
+        u, _, k = engine.exchange(p, rows, keys, part["w"][eidx], "vote")
+        new[u + engine.offsets[p]] = k.astype(np.float64)
+    return new
+
+
+def _step_components(engine, cur, dirty, base):
+    if base is None:
+        new = cur.copy()
+    else:
+        new = base
+        new[dirty] = cur[dirty]
+    for p in range(engine.num_shards):
+        local = _local_rows(engine, p, dirty)
+        if local is not None and len(local) == 0:
+            continue
+        rows, eidx = engine.gather_edges(p, local)
+        vals = cur[engine.parts[p]["src"][eidx]]
+        u, v, _ = engine.exchange(p, rows, eidx, vals, "min")
+        g = u + engine.offsets[p]
+        new[g] = np.minimum(new[g], v)
+    return new
+
+
+# ---------------------------------------------------------------------------
+# the shared BSP loop: from-scratch AND memoized incremental replay
+# ---------------------------------------------------------------------------
+
+
+def _loop(
+    engine,
+    algo: str,
+    params: dict,
+    init_vec: np.ndarray,
+    step_fn,
+    stop_fn,
+    max_iters: int,
+    prev: AnalyticsResult | None = None,
+    struct_dirty: np.ndarray | None = None,
+    keep_trajectory: bool = True,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+) -> AnalyticsResult:
+    n = engine.num_rows
+    cur = np.asarray(init_vec, np.float64)
+    memo = None
+    if prev is not None and struct_dirty is not None:
+        memo = prev.trajectory
+        if (
+            memo is None
+            or len(memo[0]) != n
+            or not np.array_equal(_bits(memo[0]), _bits(cur))
+        ):
+            memo = None  # row space or init moved → full recompute
+    if struct_dirty is not None:
+        struct_dirty = np.unique(np.asarray(struct_dirty, np.int64))
+        struct_dirty = struct_dirty[(struct_dirty >= 0) & (struct_dirty < n)]
+        if memo is None:
+            struct_dirty = None
+    it = 0
+    ckpt = CheckpointStore(checkpoint_dir) if checkpoint_dir else None
+    if ckpt is not None and resume:
+        step = ckpt.latest_step()
+        if step is not None:
+            snap = ckpt.load(step)
+            meta = snap["meta"]
+            if (
+                meta.get("algo") == algo
+                and tuple(meta.get("epoch_pin", ())) == tuple(engine.epoch_pin)
+                and len(snap["params"][0]) == n
+            ):
+                cur = np.asarray(snap["params"][0], np.float64)
+                it = int(snap["step"])
+                memo = None  # a resumed run replays nothing
+                struct_dirty = None
+    traj = [cur.copy()]
+    # rows whose value differs bitwise from the memoized trajectory at
+    # the current iteration; None = unknown/all (forces full compute)
+    changed = np.empty(0, np.int64) if memo is not None else None
+    rows_recomputed = 0
+    converged = False
+    while it < max_iters:
+        it += 1
+        if (
+            struct_dirty is not None
+            and changed is not None
+            and memo is not None
+            and it < len(memo)
+        ):
+            dirty = np.union1d(struct_dirty, _out_neighbors(engine, changed))
+            new = step_fn(engine, cur, dirty, memo[it].copy())
+            changed = dirty[
+                _bits(new[dirty]) != _bits(np.asarray(memo[it])[dirty])
+            ]
+            rows_recomputed += len(dirty)
+        else:
+            new = step_fn(engine, cur, None, None)
+            changed = None
+            rows_recomputed += n
+        traj.append(new)
+        if ckpt is not None and checkpoint_every and it % checkpoint_every == 0:
+            ckpt.save_leaves(
+                it, [new], [],
+                extra_meta={
+                    "algo": algo,
+                    "epoch_pin": list(engine.epoch_pin),
+                    "params": {
+                        k: v for k, v in params.items()
+                        if isinstance(v, (int, float, str, bool))
+                    },
+                },
+            )
+        stop = stop_fn(cur, new)
+        cur = new
+        if stop:
+            converged = True
+            break
+    stats = dict(engine.stats)
+    stats["rows_recomputed"] = rows_recomputed
+    stats["num_rows"] = n
+    stats["num_edges"] = engine.num_edges
+    stats["boundary_edges"] = engine.boundary_edges
+    return AnalyticsResult(
+        algo=algo,
+        values=cur,
+        node_ids=engine.node_ids,
+        offsets=engine.offsets,
+        epoch_pin=tuple(engine.epoch_pin),
+        iterations=it,
+        converged=converged,
+        trajectory=traj if keep_trajectory else None,
+        stats=stats,
+        params=dict(params),
+    )
+
+
+def _make_engine(graph, params: dict) -> WholeGraphEngine:
+    return WholeGraphEngine(
+        graph,
+        edge_types=params.get("edge_types"),
+        device=bool(params.get("device", False)),
+        exchange=params.get("exchange", "auto"),
+        symmetric=bool(params.get("symmetric", False)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# public algorithms
+# ---------------------------------------------------------------------------
+
+
+def pagerank(
+    graph,
+    damping: float = 0.85,
+    tol: float = 1e-10,
+    max_iters: int = 100,
+    edge_types=None,
+    device: bool = False,
+    exchange: str = "auto",
+    engine: WholeGraphEngine | None = None,
+    keep_trajectory: bool = True,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+    _prev: AnalyticsResult | None = None,
+    _struct_dirty=None,
+) -> AnalyticsResult:
+    """Weighted damped PageRank with a tolerance stop (max |Δ| ≤ tol
+    over the full vector). No dangling-mass redistribution — see the
+    module docstring for why that keeps incremental recompute local."""
+    params = {
+        "damping": float(damping), "tol": float(tol),
+        "max_iters": int(max_iters), "edge_types": edge_types,
+        "device": bool(device), "exchange": exchange, "symmetric": False,
+    }
+    if engine is None:
+        engine = _make_engine(graph, params)
+    n = engine.num_rows
+    init = np.full(n, 1.0 / n if n else 0.0, np.float64)
+    return _loop(
+        engine, "pagerank", params, init,
+        lambda e, cur, dirty, base: _step_pagerank(
+            e, cur, dirty, base, params["damping"]
+        ),
+        lambda cur, new: bool(
+            np.max(np.abs(new - cur), initial=0.0) <= params["tol"]
+        ),
+        params["max_iters"],
+        prev=_prev, struct_dirty=_struct_dirty,
+        keep_trajectory=keep_trajectory,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        resume=resume,
+    )
+
+
+def label_propagation(
+    graph,
+    max_iters: int = 30,
+    edge_types=None,
+    device: bool = False,
+    exchange: str = "auto",
+    engine: WholeGraphEngine | None = None,
+    keep_trajectory: bool = True,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+    _prev: AnalyticsResult | None = None,
+    _struct_dirty=None,
+) -> AnalyticsResult:
+    """Synchronous weighted label propagation: each row adopts the
+    in-neighbor label with the highest total edge weight (ties to the
+    smallest label); rows with no in-edges keep their own. Labels start
+    as global id-ranks, so they are node-identity stable."""
+    params = {
+        "max_iters": int(max_iters), "edge_types": edge_types,
+        "device": bool(device), "exchange": exchange, "symmetric": False,
+    }
+    if engine is None:
+        engine = _make_engine(graph, params)
+    init = _id_ranks(engine)
+    return _loop(
+        engine, "lp", params, init, _step_label_prop,
+        lambda cur, new: bool(np.array_equal(_bits(cur), _bits(new))),
+        params["max_iters"],
+        prev=_prev, struct_dirty=_struct_dirty,
+        keep_trajectory=keep_trajectory,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        resume=resume,
+    )
+
+
+def connected_components(
+    graph,
+    max_iters: int = 200,
+    edge_types=None,
+    device: bool = False,
+    exchange: str = "auto",
+    engine: WholeGraphEngine | None = None,
+    keep_trajectory: bool = True,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
+    _prev: AnalyticsResult | None = None,
+    _struct_dirty=None,
+) -> AnalyticsResult:
+    """Connected components on the undirected view: iterative min-label
+    until fixpoint. Component label = smallest member id-rank."""
+    params = {
+        "max_iters": int(max_iters), "edge_types": edge_types,
+        "device": bool(device), "exchange": exchange, "symmetric": True,
+    }
+    if engine is None:
+        engine = _make_engine(graph, params)
+    init = _id_ranks(engine)
+    return _loop(
+        engine, "cc", params, init, _step_components,
+        lambda cur, new: bool(np.array_equal(_bits(cur), _bits(new))),
+        params["max_iters"],
+        prev=_prev, struct_dirty=_struct_dirty,
+        keep_trajectory=keep_trajectory,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        resume=resume,
+    )
+
+
+_ALGOS = {
+    "pagerank": pagerank,
+    "lp": label_propagation,
+    "cc": connected_components,
+}
+
+
+def rerun_incremental(
+    graph,
+    prev: AnalyticsResult,
+    publish: dict | None = None,
+    mutated_rows=None,
+    engine: WholeGraphEngine | None = None,
+    keep_trajectory: bool = True,
+) -> AnalyticsResult:
+    """Recompute ``prev`` against the CURRENT epoch, touching only rows
+    the mutation could have reached.
+
+    ``mutated_rows`` (or ``publish["rows"]`` from ``GraphWriter.publish``)
+    seeds the dirty set; each iteration the set advances one out-edge
+    hop, every clean row is copied from ``prev.trajectory``, and the
+    replayed schedule converges to bit-exactly the from-scratch result
+    at the new epoch. Degrades to a full recompute when the mutated-row
+    set is unknown (publish rows=None), the node count moved, or the
+    previous run kept no trajectory. Passing the previous run's
+    ``engine`` also reuses its cached adjacency, refetching only the
+    mutated rows (``stats["rows_refetched"]``).
+    """
+    if prev.algo not in _ALGOS:
+        raise ValueError(f"unknown analytics algo {prev.algo!r}")
+    rows = mutated_rows
+    if rows is None and publish is not None:
+        rows = publish.get("rows")
+    if rows is not None:
+        rows = np.asarray(rows, np.int64)
+    if (
+        publish is not None
+        and publish.get("num_nodes") is not None
+        and int(publish["num_nodes"]) != len(prev.values)
+    ):
+        rows = None  # row space changed: init depends on N → full rerun
+    if rows is None:
+        engine = None  # full rerun must re-pin at the current epoch
+    elif engine is not None:
+        try:
+            engine.refresh_rows(rows)
+        except ValueError:
+            engine = None  # shard node counts moved under us
+    if engine is None:
+        engine = _make_engine(graph, prev.params)
+        if int(engine.num_rows) != len(prev.values):
+            rows = None
+    if rows is not None:
+        # a mutated SRC row changes the normalized weight (and the label
+        # messages) of EVERY edge it emits — its out-neighbors' in-edge
+        # view changed too, so they are structurally dirty as well
+        n = engine.num_rows
+        rows = rows[(rows >= 0) & (rows < n)]
+        rows = np.union1d(rows, _out_neighbors(engine, rows))
+    kwargs = {
+        k: v for k, v in prev.params.items()
+        if k not in ("symmetric",)
+    }
+    return _ALGOS[prev.algo](
+        graph,
+        engine=engine,
+        keep_trajectory=keep_trajectory,
+        _prev=prev if rows is not None else None,
+        _struct_dirty=rows,
+        **kwargs,
+    )

@@ -1,0 +1,231 @@
+"""KG-embedding sweeps as a whole-graph workload (ISSUE 12).
+
+A sweep drives ``models/kg.py`` TransE/DistMult-family training through
+full-graph negative-sampling epochs, with the three disciplines the
+analytics lane guarantees everywhere else:
+
+  epoch pinning    the triple list, entity universe and evaluation set
+      are extracted ONCE from a ``WholeGraphEngine`` (which captures the
+      shard stores at construction), so every config in the sweep trains
+      and evaluates against exactly one published ``graph_epoch`` even
+      while writers stream mutations.
+  determinism      triples are collected in sorted (h, r, t) order;
+      batches cycle a seeded permutation of the full pinned triple list
+      (reshuffled per epoch) with negatives drawn from the pinned entity
+      list — two runs of the same sweep produce identical leaderboards.
+  durability       each config commits its final params/opt state
+      through the PR-10 retained checkpoint store (atomic tmp → fsync →
+      COMMIT → rename, keep-N), with the epoch pin and the evaluation
+      metrics in the checkpoint meta; re-running the sweep with
+      ``resume=True`` skips configs whose committed checkpoint already
+      matches the pinned epoch (a shard death mid-sweep surfaces as the
+      usual typed RpcError, and the restart pays only for the configs
+      that had not committed — OPERATIONS.md).
+
+Evaluation uses the filtered ranking metrics (``kg_ranking_metrics``)
+with the pinned triple list as the filter set.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from euler_tpu.analytics.primitives import WholeGraphEngine
+from euler_tpu.training.checkpoint import CheckpointStore
+
+DEFAULT_CONFIGS = (
+    {"variant": "transe", "dim": 16, "learning_rate": 0.05},
+    {"variant": "distmult", "dim": 16, "learning_rate": 0.05},
+)
+
+
+def collect_triples(graph, edge_types=None, engine=None):
+    """Pinned-epoch triple extraction: every edge as (h=src id,
+    r=type, t=dst id), int64 [E, 3] sorted by (h, r, t) — the
+    deterministic full-graph training set AND the filter set for the
+    filtered ranking metrics. Returns (triples, entity_ids, engine)."""
+    if engine is None:
+        engine = WholeGraphEngine(graph, edge_types=edge_types)
+    h = engine.edge_src_id.astype(np.int64)
+    t = engine.node_ids[engine.edge_dst].astype(np.int64)
+    r = engine.edge_tt.astype(np.int64)
+    triples = np.stack([h, r, t], axis=1)
+    order = np.lexsort((triples[:, 2], triples[:, 1], triples[:, 0]))
+    triples = triples[order]
+    entity_ids = np.sort(engine.node_ids.astype(np.int64))
+    return triples, entity_ids, engine
+
+
+def pinned_kg_batches(
+    triples: np.ndarray,
+    entity_ids: np.ndarray,
+    batch_size: int,
+    num_negs: int = 4,
+    rng=None,
+    seed: int = 0,
+):
+    """Batch source over the PINNED triple list: cycles a seeded
+    permutation of all triples (reshuffled each epoch — full-graph
+    negative-sampling epochs, not i.i.d. edge draws) with corrupted
+    heads/tails drawn uniformly from the pinned entity list."""
+    rng = rng if rng is not None else np.random.default_rng(seed)
+    triples = np.asarray(triples, np.int64)
+    entity_ids = np.asarray(entity_ids, np.int64)
+    state = {"perm": rng.permutation(len(triples)), "pos": 0}
+
+    def to32(x):
+        return np.asarray(x, np.int64).astype(np.int32)
+
+    def fn():
+        take = []
+        need = batch_size
+        while need > 0:
+            perm, pos = state["perm"], state["pos"]
+            got = perm[pos:pos + need]
+            take.append(got)
+            need -= len(got)
+            state["pos"] = pos + len(got)
+            if state["pos"] >= len(perm):  # epoch boundary: reshuffle
+                state["perm"] = rng.permutation(len(triples))
+                state["pos"] = 0
+        e = triples[np.concatenate(take)]
+        negs = entity_ids[
+            rng.integers(0, len(entity_ids), batch_size * num_negs * 2)
+        ].reshape(2, batch_size, num_negs)
+        return (
+            {
+                "h": to32(e[:, 0]),
+                "r": to32(e[:, 1]),
+                "t": to32(e[:, 2]),
+                "neg_h": to32(negs[0]),
+                "neg_t": to32(negs[1]),
+            },
+        )
+
+    return fn
+
+
+def _config_name(cfg: dict) -> str:
+    lr = cfg.get("learning_rate", 0.05)
+    return f"{cfg.get('variant', 'transe')}_d{cfg.get('dim', 16)}_lr{lr}"
+
+
+def run_kg_sweep(
+    graph,
+    out_dir: str,
+    configs=None,
+    steps: int = 40,
+    batch_size: int = 32,
+    num_negs: int = 4,
+    seed: int = 0,
+    edge_types=None,
+    eval_triples: int = 128,
+    keep: int = 3,
+    resume: bool = True,
+) -> dict:
+    """Sweep KG-embedding configs over the pinned full graph; returns
+    {"epoch_pin", "num_triples", "num_entities", "leaderboard"} with the
+    leaderboard sorted by filtered MRR (desc, ties by config name)."""
+    import jax
+
+    from euler_tpu.estimator import Estimator, EstimatorConfig
+    from euler_tpu.models.kg import TransX, kg_ranking_metrics
+
+    configs = [dict(c) for c in (configs or DEFAULT_CONFIGS)]
+    triples, entity_ids, engine = collect_triples(
+        graph, edge_types=edge_types
+    )
+    epoch_pin = list(engine.epoch_pin)
+    num_entities = int(entity_ids.max(initial=0))
+    num_relations = max(
+        int(engine.edge_tt.max(initial=0)) + 1,
+        int(getattr(graph.meta, "num_edge_types", 1)),
+    )
+    eval_set = triples[:min(int(eval_triples), len(triples))]
+    leaderboard = []
+    for i, cfg in enumerate(configs):
+        name = _config_name(cfg)
+        mdir = os.path.join(out_dir, name)
+        store = CheckpointStore(mdir, keep=keep)
+        if resume:
+            step = store.latest_step()
+            if step is not None:
+                meta = store.load(step)["meta"]
+                sweep_meta = meta.get("sweep") or {}
+                if (
+                    meta.get("graph_epoch") == epoch_pin
+                    and sweep_meta.get("metrics")
+                ):
+                    leaderboard.append({
+                        "name": name,
+                        "config": cfg,
+                        "metrics": sweep_meta["metrics"],
+                        "final_loss": sweep_meta.get("final_loss"),
+                        "checkpoint": store._path(step),
+                        "resumed": True,
+                    })
+                    continue
+        rng = np.random.default_rng(seed + i)
+        model = TransX(
+            num_entities=num_entities,
+            num_relations=num_relations,
+            dim=int(cfg.get("dim", 16)),
+            rel_dim=int(cfg.get("rel_dim", 0)),
+            variant=cfg.get("variant", "transe"),
+        )
+        est_cfg = EstimatorConfig(
+            model_dir=mdir,
+            total_steps=int(steps),
+            learning_rate=float(cfg.get("learning_rate", 0.05)),
+            log_steps=10**9,
+            seed=seed,
+        )
+        est = Estimator(
+            model,
+            pinned_kg_batches(
+                triples, entity_ids, batch_size,
+                num_negs=num_negs, rng=rng,
+            ),
+            est_cfg,
+        )
+        hist = est.train(save=False)
+        metrics = kg_ranking_metrics(
+            model, est.params, eval_set, num_entities,
+            filter_triples=triples,
+        )
+        p_leaves = [
+            np.asarray(v) for v in jax.tree_util.tree_leaves(est.params)
+        ]
+        o_leaves = [
+            np.asarray(v) for v in jax.tree_util.tree_leaves(est.opt_state)
+        ]
+        path = store.save_leaves(
+            int(steps), p_leaves, o_leaves,
+            extra_meta={
+                "graph_epoch": epoch_pin,
+                "sweep": {
+                    "name": name,
+                    "config": cfg,
+                    "seed": int(seed),
+                    "metrics": metrics,
+                    "final_loss": float(np.asarray(hist)[-1]),
+                },
+            },
+        )
+        leaderboard.append({
+            "name": name,
+            "config": cfg,
+            "metrics": metrics,
+            "final_loss": float(np.asarray(hist)[-1]),
+            "checkpoint": path,
+            "resumed": False,
+        })
+    leaderboard.sort(key=lambda e: (-e["metrics"]["mrr"], e["name"]))
+    return {
+        "epoch_pin": epoch_pin,
+        "num_triples": int(len(triples)),
+        "num_entities": num_entities,
+        "leaderboard": leaderboard,
+    }

@@ -154,6 +154,60 @@ def _segment_arange(counts: np.ndarray) -> np.ndarray:
     return np.arange(total) - np.repeat(ends - counts, counts)
 
 
+# ---------------------------------------------------------------------------
+# analytics frontier staging (euler_tpu/analytics)
+# ---------------------------------------------------------------------------
+# The whole-graph engine keeps per-shard dense f64 vertex state; staging
+# it in HBM needs jax's x64 mode, which this repo leaves OFF globally
+# (conftest runs f32). The scoped enable_x64 context preserves f64 end
+# to end, so the device path's gathers and elementwise multiplies are
+# IEEE-exact twins of the numpy host path — the order-sensitive segment
+# reductions stay on the host in primitives.reduce_messages either way.
+
+
+def _x64():
+    try:
+        from jax.experimental import enable_x64
+
+        return enable_x64
+    except ImportError:  # pragma: no cover - very old jax
+        return None
+
+
+def stage_frontier(values: np.ndarray):
+    """Put one frontier shard's f64 state on device; host array when
+    x64 staging is unavailable (callers stay correct either way)."""
+    ctx = _x64()
+    values = np.ascontiguousarray(values, np.float64)
+    if ctx is None:
+        return values
+    with ctx():
+        arr = jax.device_put(values)
+    if arr.dtype != jnp.float64:  # x64 unavailable on this backend
+        return values
+    return arr
+
+
+def frontier_contrib(weights, global_vec, src_rows):
+    """Per-edge w[e] * frontier[src[e]] on device (f64 gather + multiply
+    — elementwise IEEE ops, bit-identical to the numpy host path).
+    Returns a host f64 array, or None when x64 staging is unavailable
+    (the caller then runs the numpy path)."""
+    ctx = _x64()
+    if ctx is None:
+        return None
+    with ctx():
+        vec = jnp.asarray(np.asarray(global_vec, np.float64))
+        w = jnp.asarray(np.asarray(weights, np.float64))
+        if vec.dtype != jnp.float64 or w.dtype != jnp.float64:
+            return None
+        out = w * jnp.take(
+            vec, jnp.asarray(np.asarray(src_rows, np.int64)), axis=0
+        )
+        host = np.asarray(out, np.float64)
+    return host
+
+
 class DeviceGraphTables:
     """HBM-resident graph tables + traced draw primitives.
 

@@ -215,6 +215,7 @@ class RemoteShard:
         "condition_weight",
         "degree_sum",
         "dense_feature_udf",
+        "edges_by_rows",
         "get_binary_feature",
         "get_dense_by_rows",
         "get_dense_feature",
@@ -270,6 +271,10 @@ class RemoteShard:
         # it with unknown-op; after one such answer this shard resends
         # plain ops (deadlines then bound only the client side)
         self._deadline_wire = True
+        # same discipline for the bulk analytics CSR export: old servers
+        # answer edges_by_rows with unknown-op, after which this handle
+        # assembles the export from chunked per-row verbs instead
+        self._edges_wire = True
         # logical RPCs issued through this shard handle (retries count
         # once) — the client half of the planner's L×P → P measurement;
         # GIL-racy increments are fine for telemetry
@@ -559,6 +564,49 @@ class RemoteShard:
                 lambda miss: self.call("ids_by_rows", [miss]),
             )
         )
+
+    def edges_by_rows(self, rows, edge_types=None):
+        """Bulk CSR export for the analytics engine: local rows →
+        ragged out-adjacency (counts i64, dst ids u64, weights f32,
+        types i32), type-major per row. One frame on current servers;
+        old servers answer unknown-op, after which this handle degrades
+        (sticky) to assembling the same arrays from chunked
+        ids_by_rows + get_full_neighbor calls — identical layout, so
+        callers never see the difference."""
+        rows = np.asarray(rows, np.int64)
+        if self._edges_wire:
+            try:
+                c, d, w, t = self.call(
+                    "edges_by_rows", [rows, _types(edge_types)]
+                )
+                return (
+                    np.asarray(c, np.int64), np.asarray(d, np.uint64),
+                    np.asarray(w, np.float32), np.asarray(t, np.int32),
+                )
+            except RpcError as e:
+                if "unknown op" not in str(e):
+                    raise
+                self._edges_wire = False
+        # chunked per-row fallback: the padded neighbor verb, compacted
+        # back to the ragged layout (row-major, type-major per row —
+        # get_full_neighbor fills types in ascending order too)
+        counts = np.zeros(len(rows), np.int64)
+        dst, w, tt = [], [], []
+        chunk = 512
+        for lo in range(0, len(rows), chunk):
+            sub = rows[lo:lo + chunk]
+            ids = np.asarray(self.ids_by_rows(sub)[0], np.uint64)
+            nbr, ww, ty, mask, _ = self.get_full_neighbor(ids, edge_types)
+            mask = np.asarray(mask, bool)
+            counts[lo:lo + chunk] = mask.sum(axis=1)
+            dst.append(np.asarray(nbr, np.uint64)[mask])
+            w.append(np.asarray(ww, np.float32)[mask])
+            tt.append(np.asarray(ty, np.int32)[mask])
+        if not dst:
+            return (counts, np.empty(0, np.uint64),
+                    np.empty(0, np.float32), np.empty(0, np.int32))
+        return (counts, np.concatenate(dst), np.concatenate(w),
+                np.concatenate(tt))
 
     def sample_node(self, count, node_type=-1, rng=None):
         return self.call("sample_node", [count, node_type, _seed(rng)])[0]

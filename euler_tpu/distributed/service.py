@@ -509,7 +509,9 @@ class GraphService:
         "degree_sum",
         "delete_edges",
         "dense_feature_udf",
+        "edges_by_rows",
         "exec_plan",
+        "frontier_exchange",
         "get_binary_feature",
         "get_dense_by_rows",
         "get_dense_feature",
@@ -620,6 +622,67 @@ class GraphService:
                     ok, np.asarray(s.node_types, np.int32)[safe], -1
                 ).astype(np.int32),
             ]
+        if op == "edges_by_rows":
+            # bulk CSR export for the whole-graph analytics engine
+            # (ISSUE 12): local rows → ragged out-adjacency (counts,
+            # dst ids, weights, types), type-major per row in storage
+            # order — deterministic, so the response is a pure function
+            # of the published epoch. Out-of-range rows export degree 0.
+            rows = np.asarray(a[0], np.int64)
+            etypes = None if len(a) < 2 or a[1] is None else [
+                int(t) for t in np.asarray(a[1]).ravel()
+            ]
+            n = int(s.num_nodes)
+            ok = (rows >= 0) & (rows < n)
+            safe = np.clip(rows, 0, max(n - 1, 0))
+            types = (
+                range(len(s.adj)) if etypes is None
+                else [t for t in etypes if 0 <= t < len(s.adj)]
+            )
+            row_pos, dst, w, tt = [], [], [], []
+            for t in types:
+                c = s.adj[t]
+                indptr = np.asarray(c.indptr, np.int64)
+                lens = np.where(ok, indptr[safe + 1] - indptr[safe], 0)
+                total = int(lens.sum())
+                idx = np.repeat(indptr[safe], lens)
+                if total:
+                    step = np.arange(total, dtype=np.int64)
+                    step -= np.repeat(
+                        np.cumsum(lens, dtype=np.int64) - lens, lens
+                    )
+                    idx = idx + step
+                row_pos.append(
+                    np.repeat(np.arange(len(rows), dtype=np.int64), lens)
+                )
+                dst.append(np.asarray(c.dst, np.uint64)[idx])
+                w.append(np.asarray(c.w, np.float32)[idx])
+                tt.append(np.full(total, t, np.int32))
+            if not row_pos:
+                return [
+                    np.zeros(len(rows), np.int64),
+                    np.empty(0, np.uint64),
+                    np.empty(0, np.float32),
+                    np.empty(0, np.int32),
+                ]
+            row_pos = np.concatenate(row_pos)
+            order = np.lexsort((np.concatenate(tt), row_pos))
+            return [
+                np.bincount(row_pos, minlength=len(rows)).astype(np.int64),
+                np.concatenate(dst)[order],
+                np.concatenate(w)[order],
+                np.concatenate(tt)[order],
+            ]
+        if op == "frontier_exchange":
+            # boundary-vertex message reduction for the analytics BSP
+            # step: (rows, keys, vals, mode) → per-row reduction in THE
+            # canonical order (primitives.reduce_messages — the same
+            # function the client's in-process path runs, so local and
+            # remote execution agree bit-for-bit). Stateless and pure.
+            from euler_tpu.analytics.primitives import reduce_messages
+
+            u, v, k = reduce_messages(a[0], a[1], a[2], str(a[3]))
+            return [u, v, k]
         if op == "exec_plan":
             # fused per-shard sub-plan (SPLIT → REMOTE → MERGE parity,
             # optimizer.h:49-86): the whole compiled chain for this

@@ -12,6 +12,7 @@ from euler_tpu.models.kg import (  # noqa: F401
     TransX,
     kg_batches,
     kg_rank_eval,
+    kg_ranking_metrics,
     transx_warm_start,
 )
 from euler_tpu.models.layerwise_models import LayerwiseGCN  # noqa: F401

@@ -1,0 +1,391 @@
+"""Whole-graph analytics console: the operational face of ISSUE 12.
+
+Runs the offline workload class — PageRank, label propagation,
+connected components, KG-embedding sweeps — against a local graph
+directory or a live cluster, with the same guarantees the library
+makes: one pinned epoch per run, bit-deterministic results, durable
+state through the retained checkpoint store.
+
+    python -m euler_tpu.tools.analytics --algo pagerank --data DIR
+    python -m euler_tpu.tools.analytics --algo cc \
+        --registry REG --num-shards N --state-dir STATE
+    python -m euler_tpu.tools.analytics --algo pagerank --data DIR \
+        --state-dir STATE --incremental
+    python -m euler_tpu.tools.analytics --algo kg-sweep --data DIR \
+        --state-dir STATE --steps 40
+    python -m euler_tpu.tools.analytics --selftest
+
+Each invocation prints one JSON line. ``--state-dir`` persists the run
+(values, trajectory, per-row adjacency signatures) via the PR-10
+retained checkpoint store; a later ``--incremental`` run diffs the
+saved signatures against the current epoch and reseeds only the rows
+whose adjacency actually changed — converging to bit-exactly the
+from-scratch answer (tests/test_analytics.py pins this).
+
+``--epoch-pin E0,E1,...`` asserts the engine pinned exactly those
+per-shard epochs (exit 3 otherwise) — the operational guard that a run
+scheduled "after last night's publish" really is reading that epoch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _mix(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — a cheap, stable per-element hash."""
+    x = np.asarray(x, np.uint64).copy()
+    x += np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def row_signatures(engine) -> np.ndarray:
+    """One u64 per global row summarizing its out-adjacency — the
+    change detector behind ``--incremental``. Commutative (wrapping sum
+    of per-edge hashes), so it is independent of edge order AND of
+    shard count; two epochs disagree on a row iff its signature moved
+    (up to hash collisions)."""
+    n = engine.num_rows
+    sig = np.zeros(n, np.uint64)
+    if engine.num_edges:
+        dst_id = engine.node_ids[engine.edge_dst]
+        h = _mix(
+            _mix(dst_id)
+            ^ _mix(engine.edge_w.view(np.uint64))
+            ^ _mix(engine.edge_tt.astype(np.uint64))
+        )
+        np.add.at(sig, engine.edge_src, h)
+    deg = np.zeros(n, np.int64)
+    if engine.num_edges:
+        np.add.at(deg, engine.edge_src, 1)
+    return sig ^ _mix(deg.astype(np.uint64))
+
+
+# ---------------------------------------------------------------------------
+# durable run state (retained checkpoints)
+# ---------------------------------------------------------------------------
+
+
+def save_state(state_dir: str, algo: str, result, sigs: np.ndarray) -> str:
+    from euler_tpu.training.checkpoint import CheckpointStore
+
+    store = CheckpointStore(state_dir, keep=2)
+    traj = result.trajectory or [result.values]
+    return store.save_leaves(
+        result.iterations,
+        list(traj),
+        [result.node_ids, np.asarray(result.offsets, np.int64), sigs],
+        extra_meta={
+            "algo": algo,
+            "analytics": {
+                "params": {
+                    k: v for k, v in result.params.items()
+                    if not isinstance(v, np.ndarray)
+                },
+                "epoch_pin": list(result.epoch_pin),
+                "iterations": int(result.iterations),
+                "converged": bool(result.converged),
+            },
+        },
+    )
+
+
+def load_state(state_dir: str, algo: str):
+    """Saved run → (AnalyticsResult, signatures u64) or None."""
+    from euler_tpu.analytics import AnalyticsResult
+    from euler_tpu.training.checkpoint import CheckpointStore
+
+    store = CheckpointStore(state_dir, keep=2)
+    if store.latest_step() is None:
+        return None
+    snap = store.load()
+    meta = snap["meta"].get("analytics")
+    if snap["meta"].get("algo") != algo or not meta:
+        return None
+    traj = [np.asarray(v, np.float64) for v in snap["params"]]
+    node_ids, offsets, sigs = snap["opt_state"]
+    prev = AnalyticsResult(
+        algo=algo,
+        values=traj[-1],
+        node_ids=np.asarray(node_ids, np.uint64),
+        offsets=np.asarray(offsets, np.int64),
+        epoch_pin=tuple(meta["epoch_pin"]),
+        iterations=int(meta["iterations"]),
+        converged=bool(meta["converged"]),
+        trajectory=traj,
+        params=dict(meta["params"]),
+    )
+    return prev, np.asarray(sigs, np.uint64)
+
+
+def mutated_rows_from_signatures(engine, prev, prev_sigs, cur_sigs):
+    """Rows whose out-adjacency signature moved between the saved run
+    and the current epoch, compared BY NODE ID (row spaces may be
+    ordered differently); None = incomparable → full recompute."""
+    if len(prev.node_ids) != engine.num_rows:
+        return None
+    po = np.argsort(prev.node_ids, kind="stable")
+    co = np.argsort(engine.node_ids, kind="stable")
+    if not np.array_equal(prev.node_ids[po], engine.node_ids[co]):
+        return None
+    diff = prev_sigs[po] != cur_sigs[co]
+    return np.asarray(co[diff], np.int64)
+
+
+# ---------------------------------------------------------------------------
+# the independent single-shard oracle (--selftest)
+# ---------------------------------------------------------------------------
+
+
+def _oracle(data: dict, algo: str, damping=0.85, tol=1e-10, iters=100):
+    """~20-line single-partition NumPy reference using the SAME
+    canonical order the engine buys determinism with — (dst, src_id,
+    type, weight_bits) — but none of its code. Returns (ids, values)."""
+    ids = np.array(sorted(n["id"] for n in data["nodes"]), np.uint64)
+    rank = {int(i): r for r, i in enumerate(ids)}
+    src = np.array([rank[e["src"]] for e in data["edges"]], np.int64)
+    dst = np.array([rank[e["dst"]] for e in data["edges"]], np.int64)
+    w = np.array([e["weight"] for e in data["edges"]], np.float64)
+    tt = np.array([e["type"] for e in data["edges"]], np.int64)
+    n = len(ids)
+    if algo == "cc":
+        src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
+        cur = np.arange(n, dtype=np.float64)
+        for _ in range(iters):
+            new = cur.copy()
+            np.minimum.at(new, dst, cur[src])
+            if np.array_equal(new, cur):
+                break
+            cur = new
+        return ids, cur
+    wb = w.view(np.uint64)
+    if algo == "lp":
+        cur = np.arange(n, dtype=np.float64)
+        for _ in range(iters):
+            new, k, r = cur.copy(), cur[src].astype(np.int64), dst
+            o = np.lexsort((wb, k, r))
+            r2, k2, v2 = r[o], k[o], w[o]
+            st = np.concatenate(
+                [[0], np.flatnonzero(np.diff(r2) | np.diff(k2)) + 1]
+            )
+            gs = np.add.reduceat(v2, st)
+            pick = np.lexsort((k2[st], -gs, r2[st]))
+            rr, first = np.unique(r2[st][pick], return_index=True)
+            new[rr] = k2[st][pick][first].astype(np.float64)
+            if np.array_equal(new, cur):
+                break
+            cur = new
+        return ids, cur
+    o = np.lexsort((wb, tt, ids[dst], src))  # out-weight sums, canon order
+    out_w = np.bincount(src[o], weights=w[o], minlength=n)
+    wn = np.divide(w, out_w[src], out=np.zeros_like(w), where=out_w[src] > 0)
+    o = np.lexsort((wb, tt, ids[src], dst))  # per-dst reduction order
+    cur = np.full(n, 1.0 / n)
+    for _ in range(iters):
+        new = np.full(n, (1.0 - damping) / n)
+        new += damping * np.bincount(
+            dst[o], weights=(wn * cur[src])[o], minlength=n
+        )
+        if np.max(np.abs(new - cur)) <= tol:
+            cur = new
+            break
+        cur = new
+    return ids, cur
+
+
+def _selftest() -> int:
+    """2-shard engine vs the independent oracle, bit-compared by id,
+    for all three algorithms."""
+    from euler_tpu.analytics import (
+        WholeGraphEngine,
+        connected_components,
+        label_propagation,
+        pagerank,
+    )
+    from euler_tpu.graph import Graph
+
+    n = 48
+    data = {
+        "nodes": [
+            {"id": i, "type": i % 2, "weight": 1.0, "features": []}
+            for i in range(1, n + 1)
+        ],
+        "edges": [
+            {"src": s, "dst": (s + off) % n + 1, "type": off % 2,
+             "weight": float(1 + (s + off) % 4), "features": []}
+            for s in range(1, n + 1)
+            for off in (1, 3, 7)
+        ],
+    }
+    graph = Graph.from_json(data, num_partitions=2)
+    runs = {
+        "pagerank": pagerank(graph, max_iters=100, tol=1e-10),
+        "lp": label_propagation(graph),
+        "cc": connected_components(graph),
+    }
+    for algo, res in runs.items():
+        ids, want = _oracle(data, algo)
+        got_ids, got = res.by_id()
+        if not np.array_equal(got_ids, ids) or not np.array_equal(
+            got.view(np.uint64), want.view(np.uint64)
+        ):
+            print(f"selftest FAILED: {algo} diverged from the oracle",
+                  file=sys.stderr)
+            return 1
+    eng = WholeGraphEngine(graph)
+    sigs = row_signatures(eng)
+    if len(np.unique(sigs)) < 2:
+        print("selftest FAILED: degenerate row signatures", file=sys.stderr)
+        return 1
+    print(json.dumps({"selftest": "ok", "algos": sorted(runs)}))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def _load_graph(args, ap):
+    if args.data:
+        from euler_tpu.graph import Graph
+
+        return Graph.load(args.data, native=False)
+    if args.registry:
+        from euler_tpu.distributed import connect
+
+        return connect(
+            registry_path=args.registry, num_shards=args.num_shards
+        )
+    ap.error("need --data or --registry (or --selftest)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--algo", choices=["pagerank", "lp", "cc", "kg-sweep"],
+        default="pagerank",
+    )
+    ap.add_argument("--data", default=None, help="local graph directory")
+    ap.add_argument("--registry", default=None)
+    ap.add_argument("--num-shards", type=int, default=None)
+    ap.add_argument("--damping", type=float, default=0.85)
+    ap.add_argument("--tol", type=float, default=1e-10)
+    ap.add_argument("--max-iters", type=int, default=100)
+    ap.add_argument("--device", action="store_true",
+                    help="stage frontier math on the accelerator")
+    ap.add_argument("--exchange", choices=["auto", "local", "remote"],
+                    default="auto")
+    ap.add_argument("--state-dir", default=None,
+                    help="persist/load run state (retained checkpoints)")
+    ap.add_argument("--incremental", action="store_true",
+                    help="diff saved signatures; recompute only mutated rows")
+    ap.add_argument("--epoch-pin", default=None,
+                    help="comma-separated per-shard epochs the run MUST pin")
+    ap.add_argument("--steps", type=int, default=40, help="kg-sweep steps")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    graph = _load_graph(args, ap)
+
+    if args.algo == "kg-sweep":
+        from euler_tpu.analytics import run_kg_sweep
+
+        if not args.state_dir:
+            ap.error("kg-sweep needs --state-dir for its checkpoints")
+        out = run_kg_sweep(
+            graph, args.state_dir, steps=args.steps,
+            batch_size=args.batch, seed=args.seed,
+        )
+        if args.epoch_pin is not None:
+            want = [int(x) for x in args.epoch_pin.split(",")]
+            if list(out["epoch_pin"]) != want:
+                print(json.dumps({
+                    "error": "epoch-pin mismatch",
+                    "pinned": list(out["epoch_pin"]), "want": want,
+                }))
+                return 3
+        out["leaderboard"] = [
+            {k: e[k] for k in ("name", "metrics", "final_loss", "resumed")}
+            for e in out["leaderboard"]
+        ]
+        print(json.dumps(out))
+        return 0
+
+    from euler_tpu.analytics import (
+        WholeGraphEngine,
+        connected_components,
+        label_propagation,
+        pagerank,
+        rerun_incremental,
+    )
+
+    engine = WholeGraphEngine(
+        graph,
+        device=args.device,
+        exchange=args.exchange,
+        symmetric=args.algo == "cc",
+    )
+    if args.epoch_pin is not None:
+        want = tuple(int(x) for x in args.epoch_pin.split(","))
+        if tuple(engine.epoch_pin) != want:
+            print(json.dumps({
+                "error": "epoch-pin mismatch",
+                "pinned": list(engine.epoch_pin), "want": list(want),
+            }))
+            return 3
+    cur_sigs = row_signatures(engine)
+    saved = (
+        load_state(args.state_dir, args.algo) if args.state_dir else None
+    )
+    incremental = False
+    if args.incremental and saved is not None:
+        prev, prev_sigs = saved
+        rows = mutated_rows_from_signatures(engine, prev, prev_sigs, cur_sigs)
+        result = rerun_incremental(
+            graph, prev, mutated_rows=rows, engine=engine
+        )
+        incremental = rows is not None
+    elif args.algo == "pagerank":
+        result = pagerank(
+            graph, damping=args.damping, tol=args.tol,
+            max_iters=args.max_iters, engine=engine,
+        )
+    elif args.algo == "lp":
+        result = label_propagation(
+            graph, max_iters=args.max_iters, engine=engine
+        )
+    else:
+        result = connected_components(
+            graph, max_iters=args.max_iters, engine=engine
+        )
+    if args.state_dir:
+        save_state(args.state_dir, args.algo, result, cur_sigs)
+    print(json.dumps({
+        "algo": args.algo,
+        "epoch_pin": list(result.epoch_pin),
+        "iterations": result.iterations,
+        "converged": result.converged,
+        "incremental": incremental,
+        "rows_recomputed": int(result.stats.get("rows_recomputed", 0)),
+        "num_rows": int(result.stats.get("num_rows", 0)),
+        "num_edges": int(result.stats.get("num_edges", 0)),
+        "exchange_bytes": int(result.stats.get("exchange_bytes", 0)),
+        "value_digest": hex(int(
+            np.sum(_mix(result.values.view(np.uint64)), dtype=np.uint64)
+        )) if len(result.values) else "0x0",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

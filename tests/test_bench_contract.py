@@ -121,6 +121,18 @@ def test_bench_smoke_emits_final_json_line():
     assert row["resume_to_first_step_ms"] > 0
     assert row["resume_ckpt_bytes"] > 0
     assert row["resume_retained_ckpts"] >= 1
+    # the whole-graph analytics lane (ISSUE 12) must not silently
+    # vanish: PageRank sweep rate over the sharded engine, frontier
+    # exchange bytes, the incremental-vs-full replay speedup after a
+    # live publish, and the 1-shard == 2-shard == incremental
+    # bit-parity oracle all ride the artifact
+    assert row["analytics"] is True, row
+    assert row["analytics_bit_parity"] is True, row
+    assert row["analytics_pagerank_sweeps_per_sec"] > 0
+    assert row["analytics_exchange_bytes"] > 0
+    assert row["analytics_incremental_speedup_x"] > 0
+    # the incremental rerun must actually skip work, not just match bits
+    assert 0 < row["analytics_rows_recomputed_ratio"] < 1, row
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline

@@ -105,6 +105,14 @@ def test_gate_covers_the_package():
         "euler_tpu/training/session.py",
         "euler_tpu/training/checkpoint.py",
         "euler_tpu/tools/train.py",
+        # the whole-graph analytics lane (ISSUE 12): BSP frontier
+        # exchange on the wire, bit-deterministic reductions, and the
+        # sweep driver's durable checkpoints — seed-hygiene, ordered-sink
+        # and wire-protocol territory
+        "euler_tpu/analytics/primitives.py",
+        "euler_tpu/analytics/algorithms.py",
+        "euler_tpu/analytics/sweeps.py",
+        "euler_tpu/tools/analytics.py",
         "bench.py",
     ):
         assert must in rels, f"{must} escaped the lint gate"

@@ -71,6 +71,43 @@ def test_kg_rank_eval(cluster_graph, tmp_path):
     assert 1.0 <= res["mean_rank"] <= 64.0
 
 
+def test_kg_ranking_metrics_filtered(cluster_graph, tmp_path):
+    """Full-ranking metrics (ISSUE 12): deterministic, and the filtered
+    setting never scores below raw — known-true corruptions stop
+    counting as negatives."""
+    from euler_tpu.models import kg_ranking_metrics
+
+    rng = np.random.default_rng(0)
+    model = TransX(num_entities=64, num_relations=2, dim=16, variant="transe")
+    cfg = EstimatorConfig(
+        model_dir=str(tmp_path / "m"), total_steps=5, log_steps=10**9
+    )
+    est = Estimator(model, kg_batches(cluster_graph, 16, rng=rng), cfg)
+    est.train(save=False)
+    # shared (h, r) prefixes: each other's tails are known-true
+    # corruptions, so filtering MUST remove beat-counts
+    triples = np.asarray(
+        [[1, 0, 2], [1, 0, 3], [1, 0, 4], [5, 1, 6]], dtype=np.int64
+    )
+    raw = kg_ranking_metrics(model, est.params, triples, num_entities=64)
+    assert set(raw) == {
+        "mean_rank", "mrr", "hit@1", "hit@10", "filtered", "num_ranks"
+    }
+    assert not raw["filtered"] and raw["num_ranks"] == 2 * len(triples)
+    assert 1.0 <= raw["mean_rank"] <= 64.0 and 0.0 < raw["mrr"] <= 1.0
+    filt = kg_ranking_metrics(
+        model, est.params, triples, num_entities=64, filter_triples=triples
+    )
+    assert filt["filtered"]
+    assert filt["mrr"] >= raw["mrr"]
+    assert filt["mean_rank"] <= raw["mean_rank"]
+    # pure scoring — a second evaluation reproduces the numbers exactly
+    again = kg_ranking_metrics(
+        model, est.params, triples, num_entities=64, filter_triples=triples
+    )
+    assert again == filt
+
+
 def test_deepwalk_training(cluster_graph, tmp_path):
     rng = np.random.default_rng(0)
     model = SkipGramModel(num_nodes=64, dim=16)

@@ -10,6 +10,7 @@ import json
 import numpy as np
 import pytest
 
+from euler_tpu.analytics import primitives as analytics_primitives
 from euler_tpu.distributed.client import RemoteShard
 from euler_tpu.distributed.service import GraphService
 from euler_tpu.distributed.writer import GraphWriter
@@ -23,6 +24,7 @@ def test_graph_domain_tables_match():
         set(RemoteShard.WIRE_VERBS)
         | set(query_plan.WIRE_VERBS)
         | set(GraphWriter.WIRE_VERBS)
+        | set(analytics_primitives.WIRE_VERBS)
     )
     assert client_verbs == set(GraphService.HANDLED_VERBS), (
         "graph-protocol verb tables diverged:\n"
@@ -93,6 +95,7 @@ def test_remote_shard_client_surface_stays_inside_its_table():
         lambda: shard.lookup([1]),
         lambda: shard.node_type([1]),
         lambda: shard.ids_by_rows([0]),
+        lambda: shard.edges_by_rows([0]),
         lambda: shard.sample_node(1),
         lambda: shard.sample_edge(1),
         lambda: shard.sample_neighbor([1]),
