@@ -729,6 +729,172 @@ def _durability_lane(smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _availability_lane(smoke: bool) -> dict:
+    """Availability lane (ISSUE 13; EULER_BENCH_AVAILABILITY=0 opt-out):
+    replica-group cost/benefit on the artifact — acked-rows/s under
+    quorum vs async vs solo acks (what a follower ack on the commit path
+    costs), the write-unavailability window from a primary kill to the
+    first accepted post-failover write (lease-bounded), follower
+    catch-up MB/s over `wal_ship`, and the caught-up follower ==
+    primary bit-parity oracle."""
+    import shutil
+    import tempfile
+
+    from euler_tpu.distributed.registry import Registry
+    from euler_tpu.distributed.service import GraphService
+    from euler_tpu.graph import Graph
+
+    n, batches, rows_per = (50, 30, 64) if smoke else (1000, 150, 256)
+    ttl = 1.0
+    rng = np.random.default_rng(23)
+    nodes = [
+        {"id": i + 1, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense",
+                       "value": rng.normal(size=8).tolist()}]}
+        for i in range(n)
+    ]
+    edges = [
+        {"src": s, "dst": s % n + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for s in range(1, n + 1)
+    ]
+    data = {"nodes": nodes, "edges": edges}
+    tmp = tempfile.mkdtemp(prefix="etpu_bench_avail_")
+    old_ack = os.environ.get("EULER_TPU_REPL_ACK")
+
+    def reqs(tag):
+        r = np.random.default_rng(5)
+        out = []
+        for b in range(batches):
+            src = r.integers(1, n + 1, rows_per).astype(np.uint64)
+            dst = r.integers(1, n + 1, rows_per).astype(np.uint64)
+            out.append([
+                f"avail:{tag}:{b}", src, dst,
+                np.zeros(rows_per, np.int32),
+                r.random(rows_per).astype(np.float32),
+                np.empty(0, np.uint64), np.empty(0, np.uint64),
+                np.empty(0, np.int32), np.empty(0, np.float32),
+            ])
+        return out
+
+    def acked_rows_per_sec(svc, tag):
+        rs = reqs(tag)
+        t0 = time.perf_counter()
+        for a in rs:
+            svc.dispatch("upsert_edges", a)
+        return batches * rows_per / (time.perf_counter() - t0)
+
+    def boot_member(sub, rid, mode, group_size=2):
+        os.environ["EULER_TPU_REPL_ACK"] = mode
+        g = Graph.from_json(data, num_partitions=1)
+        return GraphService(
+            g.shards[0], g.meta, 0,
+            registry=Registry(os.path.join(tmp, sub, "reg"), ttl=2.0),
+            wal_dir=os.path.join(tmp, sub, f"wal_r{rid}"),
+            replica=rid, group_size=group_size, lease_ttl=ttl,
+        ).start()
+
+    def wait_role(svc, role, timeout_s=20.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if svc.repl_status()["role"] == role:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"replica never became {role}")
+
+    def hard_kill(svc):
+        svc._repl._stop.set()
+        svc.server.shutdown()
+        svc.server.server_close()
+        if svc._beat is not None:
+            svc._beat.set()
+
+    svcs = []
+    try:
+        # solo baseline: same batches, no replica group on the ack path
+        solo = GraphService(
+            Graph.from_json(data, num_partitions=1).shards[0],
+            Graph.from_json(data, num_partitions=1).meta, 0,
+            wal_dir=os.path.join(tmp, "solo_wal"),
+        )
+        svcs.append(solo)
+        solo_rate = acked_rows_per_sec(solo, "solo")
+
+        # async group: the primary writes alone first (follower joins
+        # late), so the same run also times follower catch-up
+        pri_a = boot_member("a", 0, "async")
+        svcs.append(pri_a)
+        wait_role(pri_a, "primary")
+        async_rate = acked_rows_per_sec(pri_a, "async")
+        shipped_bytes = pri_a._wal.tell()
+        t0 = time.perf_counter()
+        fol_a = boot_member("a", 1, "async")
+        svcs.append(fol_a)
+        deadline = time.monotonic() + 60
+        while fol_a._wal.tell() < shipped_bytes:
+            if time.monotonic() > deadline:
+                raise TimeoutError("follower catch-up stalled")
+            time.sleep(0.005)
+        catchup_s = time.perf_counter() - t0
+        parity = set(pri_a.store.arrays) == set(fol_a.store.arrays) and all(
+            np.array_equal(
+                np.asarray(fol_a.store.arrays[k]),
+                np.asarray(pri_a.store.arrays[k]),
+            )
+            for k in pri_a.store.arrays
+        )
+
+        # quorum group: every ack waits for the follower's durable ship
+        pri_q = boot_member("q", 0, "quorum")
+        fol_q = boot_member("q", 1, "quorum")
+        svcs += [pri_q, fol_q]
+        wait_role(pri_q, "primary")
+        pri_q.dispatch("upsert_edges", reqs("warm")[0])  # follower attach
+        quorum_rate = acked_rows_per_sec(pri_q, "quorum")
+
+        # unavailability window: kill the primary, poll the survivor
+        # with ONE idempotency-keyed row until the promotion accepts it
+        hard_kill(pri_q)
+        fol_q._repl.ack_mode = "async"  # sole survivor: no quorum left
+        probe = reqs("failover")[0]
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                fol_q.dispatch("upsert_edges", probe)
+                break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.005)
+        window_ms = (time.perf_counter() - t0) * 1e3
+        return {
+            "availability": True,
+            "availability_bit_parity": bool(parity),
+            "availability_unavail_window_ms": round(window_ms, 1),
+            "availability_quorum_rows_per_sec": round(quorum_rate, 1),
+            "availability_async_rows_per_sec": round(async_rate, 1),
+            "availability_solo_rows_per_sec": round(solo_rate, 1),
+            "availability_quorum_overhead_x": round(
+                solo_rate / max(quorum_rate, 1e-9), 3
+            ),
+            "availability_catchup_mb_per_sec": round(
+                shipped_bytes / 1e6 / max(catchup_s, 1e-9), 2
+            ),
+        }
+    finally:
+        if old_ack is None:
+            os.environ.pop("EULER_TPU_REPL_ACK", None)
+        else:
+            os.environ["EULER_TPU_REPL_ACK"] = old_ack
+        for svc in svcs:
+            try:
+                svc.stop()
+            except OSError:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _resume_lane(smoke: bool) -> dict:
     """Durable-training lane (ISSUE 10; EULER_BENCH_RESUME=0 opt-out):
     checkpoint cost on the step path with the async writer vs inline
@@ -1074,6 +1240,20 @@ def run(platform: str) -> tuple[float, dict]:
             traceback.print_exc()
             extra.update(
                 {"durability": False, "durability_error": repr(e)[:300]}
+            )
+    # availability lane (ISSUE 13) — quorum/async/solo acked-rows/s,
+    # failover write-unavailability window, follower catch-up MB/s, and
+    # the caught-up follower == primary bit-parity oracle
+    if os.environ.get("EULER_BENCH_AVAILABILITY", "1") != "0":
+        try:
+            extra.update(_availability_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update(
+                {"availability": False,
+                 "availability_error": repr(e)[:300]}
             )
     # durable-training resume lane (ISSUE 10) — save-stall sync vs async,
     # resume-to-first-step latency, retained-ckpt bytes, bit-parity oracle

@@ -78,6 +78,9 @@ DEFAULT_DOMAINS = (
             # whole-graph analytics (ISSUE 12): frontier_exchange rides
             # the graph protocol from the BSP primitives
             "euler_tpu/analytics/primitives.py",
+            # shard replication (ISSUE 13): followers tail the primary's
+            # WAL with wal_ship/wal_pos/repl_status on the same protocol
+            "euler_tpu/distributed/replication.py",
         ),
         servers=("euler_tpu/distributed/service.py",),
     ),
@@ -333,9 +336,12 @@ def _union_drift(findings, domain, tables, truth, what):
 
 # the WAL's declared record-type table; must equal the writer's mutation
 # verbs = GraphWriter.WIRE_VERBS minus the read-only verbs it also sends
+# minus the replication-control verbs (repl_status/wal_pos/wal_ship ride
+# the graph protocol but replicate records, they don't create them)
 WAL_TABLE = ("euler_tpu/graph/wal.py", "WAL_VERBS")
 WAL_CLIENT = "euler_tpu/distributed/writer.py"
 WAL_READ_ONLY = ("get_meta",)
+REPL_TABLE = ("euler_tpu/distributed/replication.py", "WIRE_VERBS")
 
 
 def _named_table(mod: Module, name: str) -> tuple[list[str], int] | None:
@@ -355,6 +361,7 @@ def check_wal_lockstep(
     wal_table: tuple = WAL_TABLE,
     client_path: str = WAL_CLIENT,
     read_only: tuple = WAL_READ_ONLY,
+    repl_table: tuple = REPL_TABLE,
 ) -> list[Finding]:
     wal_path, table_name = wal_table
     wal_mod = project.module(wal_path)
@@ -379,6 +386,15 @@ def check_wal_lockstep(
     for _, (vals, _ln) in extract_tables(client_mod).items():
         mutation |= set(vals)
     mutation -= set(read_only)
+    # replication-control verbs the writer also speaks (repl_status for
+    # primary discovery) are not mutations; projects without the
+    # replication module (fixtures, older slices) skip the exemption
+    if repl_table is not None:
+        repl_mod = project.module(repl_table[0])
+        if repl_mod is not None:
+            repl_verbs = _named_table(repl_mod, repl_table[1])
+            if repl_verbs is not None:
+                mutation -= set(repl_verbs[0])
     missing = sorted(mutation - wal_verbs)
     extra = sorted(wal_verbs - mutation)
     if not missing and not extra:

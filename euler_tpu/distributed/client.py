@@ -255,7 +255,12 @@ class RemoteShard:
         retry_policy: RetryPolicy | None = None,
     ):
         self.shard = shard
-        self.replicas = [_Replica(h, p, shard) for h, p in replicas]
+        # copy-on-write tuple (same discipline as _Engine/merge_delta):
+        # readers grab ONE reference and index it; membership changes
+        # build a new tuple and swap it in a single assignment under the
+        # lock. The old list form let add_replica .append() into a list
+        # that _pick was concurrently indexing — a torn round-robin scan.
+        self.replicas = tuple(_Replica(h, p, shard) for h, p in replicas)
         self._rr = 0
         self._lock = threading.Lock()
         self._num_nodes: int | None = None
@@ -344,21 +349,70 @@ class RemoteShard:
 
     def add_replica(self, host: str, port: int):
         with self._lock:
-            self.replicas.append(_Replica(host, port, self.shard))
+            # COW: one reference swap, never in-place mutation — _pick
+            # indexes whatever tuple it snapshotted without tearing
+            self.replicas = self.replicas + (
+                _Replica(host, port, self.shard),
+            )
 
-    def _pick(self) -> _Replica:
+    def sync_replicas(self, addrs: list[tuple[str, int]]):
+        """Registry-driven topology refresh: make the replica set match
+        `addrs`. Existing _Replica objects are KEPT for addresses that
+        survive (preserving quarantine state and per-thread sockets);
+        new addresses get fresh replicas; vanished ones are dropped. One
+        COW swap, so in-flight round-robin scans see either the old or
+        the new tuple, never a half-synced one."""
+        want = [(str(h), int(p)) for h, p in addrs]
+        if not want:
+            return  # an empty registry read means "membership unknown",
+            # not "everyone is dead" — keep the current set
         with self._lock:
+            have = {(r.host, r.port): r for r in self.replicas}
+            self.replicas = tuple(
+                have.get(a) or _Replica(a[0], a[1], self.shard)
+                for a in want
+            )
+
+    def _pick(self, prefer: tuple[str, int] | None = None) -> _Replica:
+        with self._lock:
+            reps = self.replicas  # one COW snapshot per pick
             now = time.time()
-            for _ in range(len(self.replicas)):
-                r = self.replicas[self._rr % len(self.replicas)]
+            if prefer is not None:
+                host, port = str(prefer[0]), int(prefer[1])
+                for r in reps:
+                    if r.host == host and r.port == port:
+                        if r.bad_until <= now:
+                            return r
+                        break  # quarantined primary: fall to round-robin
+                else:
+                    # a preferred address the registry/redirect told us
+                    # about but the pool has never seen — a replacement
+                    # replica on a NEW port. Adopt it.
+                    r = _Replica(host, port, self.shard)
+                    self.replicas = reps + (r,)
+                    return r
+            for _ in range(len(reps)):
+                r = reps[self._rr % len(reps)]
                 self._rr += 1
                 if r.bad_until <= now:
                     return r
             # all quarantined: take the least-recently-failed (timed revival)
-            return min(self.replicas, key=lambda r: r.bad_until)
+            return min(reps, key=lambda r: r.bad_until)
 
-    def call(self, op: str, values: list, deadline_s: float | None = None) -> list:
+    def call(
+        self,
+        op: str,
+        values: list,
+        deadline_s: float | None = None,
+        prefer: tuple[str, int] | None = None,
+    ) -> list:
         """One logical RPC: failover retries under a deadline.
+
+        `prefer` pins the first attempt to one replica address (the
+        writer's primary hint in a replica group); a quarantined or
+        failing preferred replica falls back to the normal round-robin,
+        and an unknown preferred address is adopted into the pool (how
+        replacements on NEW ports get discovered).
 
         Every attempt derives its socket timeout from the remaining
         budget (capped by the policy's per-attempt timeout so one
@@ -383,7 +437,7 @@ class RemoteShard:
                     f"shard {self.shard}: {op!r} budget ({budget_s:.3f}s)"
                     f" exhausted after {attempt} attempt(s): {err}"
                 )
-            r = self._pick()
+            r = self._pick(prefer)
             try:
                 out = r.call(
                     op,
@@ -1053,17 +1107,29 @@ def connect(
     cluster: dict[int, list[tuple[str, int]]] | None = None,
     num_shards: int | None = None,
     timeout: float = 30.0,
+    watch: bool | None = None,
 ) -> Graph:
     """Build a Graph whose shards are remote.
 
     Either `cluster` (static {shard: [(host, port), ...]}) or
     `registry_path` (+ num_shards) must be given — the static-topology and
     ZK-monitor modes of the reference client (query_proxy.cc:60-144).
+
+    Registry mode additionally starts a topology watch (the ZK
+    children-watch parity, disable with watch=False): a daemon thread
+    re-reads the registry every EULER_TPU_TOPOLOGY_REFRESH_S (default
+    2s) and syncs each shard's replica set — dead replicas drop off
+    after their heartbeat lapses, replacements on NEW ports join, and
+    surviving replicas keep their quarantine state. Supervisors
+    therefore no longer need to respawn crashed servers on their old
+    fixed ports. `graph.stop_topology_watch()` stops it.
     """
+    registry = None
     if cluster is None:
         if registry_path is None or num_shards is None:
             raise ValueError("need cluster= or (registry_path=, num_shards=)")
-        cluster = make_registry(registry_path).wait_for(num_shards, timeout)
+        registry = make_registry(registry_path)
+        cluster = registry.wait_for(num_shards, timeout)
     shards = [
         RemoteShard(s, cluster[s]) for s in sorted(cluster)
     ]
@@ -1084,4 +1150,26 @@ def connect(
             f" ({len(shards)} tried): {err}"
         )
     meta = GraphMeta.from_dict(json.loads(meta_json))
-    return Graph(meta, shards)
+    g = Graph(meta, shards)
+    g.stop_topology_watch = lambda: None  # static clusters: no watch
+    if registry is not None and (watch is None or watch):
+        stop = threading.Event()
+        period = float(
+            os.environ.get("EULER_TPU_TOPOLOGY_REFRESH_S", "2.0")
+        )
+        n = len(shards)
+
+        def _watch():
+            while not stop.wait(period):
+                try:
+                    table = registry.lookup(n)
+                except (OSError, RuntimeError):
+                    continue  # registry briefly down: keep current set
+                for sh in shards:
+                    sh.sync_replicas(table.get(sh.shard, []))
+
+        threading.Thread(
+            target=_watch, daemon=True, name="topology-watch"
+        ).start()
+        g.stop_topology_watch = stop.set
+    return g

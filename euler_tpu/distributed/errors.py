@@ -37,6 +37,39 @@ class OverloadError(RpcError):
     """Admission control refused the request (bounded queue full)."""
 
 
+class NotPrimaryError(RpcError):
+    """A mutation landed on a replica that is not the group's primary
+    (follower, or a fenced ex-primary whose lease term went stale).
+
+    The detail carries the group's current coordinates so a writer can
+    re-route its keyed outbox without a registry round trip:
+
+        "NotPrimaryError: shard=3 role=follower term=7 primary=host:port"
+
+    `primary=?` when the rejecting replica does not know one (election in
+    flight) — the writer falls back to observing the lease."""
+
+    @staticmethod
+    def format(shard: int, role: str, term: int, primary) -> str:
+        addr = f"{primary[0]}:{primary[1]}" if primary else "?"
+        return f"shard={shard} role={role} term={term} primary={addr}"
+
+    @staticmethod
+    def parse_primary(message: str):
+        """(host, port) named in a NotPrimaryError detail, else None."""
+        for tok in message.split():
+            if tok.startswith("primary="):
+                addr = tok[len("primary="):]
+                if addr == "?" or ":" not in addr:
+                    return None
+                host, _, port = addr.rpartition(":")
+                try:
+                    return host, int(port)
+                except ValueError:
+                    return None
+        return None
+
+
 # pre-PR-4 serving name; same class, so except-clauses written against
 # either name keep working and the wire prefix stays one canonical string
 DeadlineExceededError = DeadlineExceeded
@@ -48,6 +81,7 @@ WIRE_ERRORS = {
     "DeadlineExceeded": DeadlineExceeded,
     "DeadlineExceededError": DeadlineExceeded,
     "OverloadError": OverloadError,
+    "NotPrimaryError": NotPrimaryError,
 }
 
 

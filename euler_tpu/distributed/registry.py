@@ -58,7 +58,171 @@ class Registry:
         t.start()
         return stop
 
+    # -- leases (PR 13 replication) --------------------------------------
+    #
+    # One term-numbered, TTL'd lease per replica group ("shard_<i>"): the
+    # holder string is the primary's "host:port", so observing the lease
+    # IS primary discovery. A new holder bumps the term — the fencing
+    # token every WAL record and wal_ship response carries. File backend:
+    # read-modify-write of `lease_<group>.json` under a short-lived
+    # O_EXCL lock file (stale locks — a holder killed mid-mutate — are
+    # broken after a few seconds).
+
+    def _lease_path(self, group: str) -> str:
+        return os.path.join(self.path, f"lease_{group}.json")
+
+    def _lease_mutate(self, group: str, fn):
+        """Run fn(current_lease_or_None) -> (new_lease_or_None, result)
+        atomically; writes the new lease when one is returned."""
+        lock = self._lease_path(group) + ".lock"
+        deadline = time.time() + 5.0
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                try:
+                    if time.time() - os.path.getmtime(lock) > 2.0:
+                        os.remove(lock)  # break a stale lock
+                        continue
+                except OSError:
+                    continue
+                if time.time() > deadline:
+                    raise TimeoutError(f"lease lock stuck: {lock}")
+                time.sleep(0.01)
+        try:
+            cur = None
+            try:
+                with open(self._lease_path(group)) as f:
+                    cur = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                cur = None
+            new, result = fn(cur)
+            if new is not None:
+                tmp = self._lease_path(group) + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(new, f)
+                os.replace(tmp, self._lease_path(group))
+            return result
+        finally:
+            try:
+                os.remove(lock)
+            except OSError:
+                pass
+
+    @staticmethod
+    def _lease_view(lease: dict | None) -> dict | None:
+        if lease is None:
+            return None
+        return {
+            "term": int(lease["term"]),
+            "holder": lease["holder"],
+            "expires_in": float(lease["expires"]) - time.time(),
+            "meta": lease.get("meta") or {},
+        }
+
+    def acquire_lease(
+        self,
+        group: str,
+        holder: str,
+        ttl: float,
+        meta: dict | None = None,
+        min_term: int = 0,
+    ) -> dict | None:
+        """Take the group's lease if it is free, expired, or already
+        ours. A NEW holder bumps the term (the fencing token); the same
+        holder re-acquiring keeps it. `min_term` floors the resulting
+        term — a promotion passes its last-observed term + 1 so a lease
+        file lost to a registry wipe can never rewind the fencing clock.
+        Returns the lease view ({term, holder, expires_in, meta}) on
+        success, None when another holder's lease is still live."""
+
+        def fn(cur):
+            now = time.time()
+            if (
+                cur is not None
+                and cur["holder"] != holder
+                and float(cur["expires"]) > now
+            ):
+                return None, None
+            term = int(cur["term"]) if cur is not None else 0
+            if cur is None or cur["holder"] != holder:
+                term += 1
+            term = max(term, int(min_term))
+            new = {
+                "group": group,
+                "term": term,
+                "holder": holder,
+                "expires": now + ttl,
+                "meta": meta or {},
+            }
+            return new, self._lease_view(new)
+
+        return self._lease_mutate(group, fn)
+
+    def renew(
+        self, group: str, holder: str, term: int, ttl: float
+    ) -> bool:
+        """Extend the lease — only when holder AND term still match (a
+        fenced ex-primary's renew fails, which is how it learns)."""
+
+        def fn(cur):
+            if (
+                cur is None
+                or cur["holder"] != holder
+                or int(cur["term"]) != int(term)
+            ):
+                return None, False
+            cur = dict(cur)
+            cur["expires"] = time.time() + ttl
+            return cur, True
+
+        return self._lease_mutate(group, fn)
+
+    def observe(self, group: str) -> dict | None:
+        """Current lease view ({term, holder, expires_in, meta}) or None.
+        `expires_in` <= 0 means expired — a follower may try promotion."""
+        try:
+            with open(self._lease_path(group)) as f:
+                return self._lease_view(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            return None
+
     # -- client side -----------------------------------------------------
+
+    def lookup_meta(
+        self, num_shards: int
+    ) -> dict[int, list[tuple[str, int, dict]]]:
+        """shard → [(host, port, meta), ...] with live heartbeats — the
+        meta carries replica ids and shipped WAL positions (replication
+        promotion reads peer positions from here)."""
+        now = time.time()
+        out: dict[int, list[tuple[str, int, dict]]] = {
+            s: [] for s in range(num_shards)
+        }
+        for name in sorted(os.listdir(self.path)):
+            if not name.endswith(".json") or name.startswith("lease_"):
+                continue
+            try:
+                with open(os.path.join(self.path, name)) as f:
+                    e = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if now - e.get("ts", 0) > self.ttl:
+                continue
+            s = int(e["shard"])
+            if s in out:
+                out[s].append((e["host"], int(e["port"]), e.get("meta") or {}))
+        return out
+
+    def members(self, shard: int) -> list[tuple[str, int, dict]]:
+        """Live (host, port, meta) entries for one shard group — the
+        replica-group view promotion reads peer positions from."""
+        try:
+            return self.lookup_meta(int(shard) + 1)[int(shard)]
+        except OSError:
+            return []
 
     def lookup(self, num_shards: int) -> dict[int, list[tuple[str, int]]]:
         """shard → [(host, port), ...] with live heartbeats."""
@@ -67,7 +231,7 @@ class Registry:
             s: [] for s in range(num_shards)
         }
         for name in sorted(os.listdir(self.path)):
-            if not name.endswith(".json"):
+            if not name.endswith(".json") or name.startswith("lease_"):
                 continue
             try:
                 with open(os.path.join(self.path, name)) as f:

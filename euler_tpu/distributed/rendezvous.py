@@ -39,6 +39,17 @@ class RendezvousServer:
       reg   (shard, host, port, meta_json) → ("ok",)   upsert + heartbeat
       unreg (shard, host, port)            → ("ok",)   immediate removal
       lookup ()                            → (table_json,)  live entries
+      lease_acquire (group, holder, ttl, min_term, meta_json)
+                                           → (lease_json|"null",)
+      lease_renew (group, holder, term, ttl) → (ok_bool,)
+      lease_observe (group)                → (lease_json|"null",)
+
+    Leases are the replication fencing primitive (PR 13): one
+    term-numbered TTL'd lease per replica group, holder = the primary's
+    "host:port". The table is in-memory — a rendezvous restart loses it —
+    so `lease_acquire` takes a `min_term` floor: a primary re-asserting
+    after a registry restart keeps its term instead of rewinding the
+    fencing clock.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
@@ -46,6 +57,8 @@ class RendezvousServer:
         self.ttl = ttl
         # (shard, host, port) → (last-heartbeat ts, meta_json)
         self._entries: dict[tuple[int, str, int], tuple[float, str]] = {}
+        # group → {"term", "holder", "expires", "meta"}
+        self._leases: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -126,7 +139,56 @@ class RendezvousServer:
                     for (s, h, p) in sorted(self._entries)
                 ]
             return wire.encode("table", [json.dumps(table)])
+        if op == "lease_acquire":
+            group, holder = str(vals[0]), str(vals[1])
+            ttl, min_term = float(vals[2]), int(vals[3])
+            meta = json.loads(str(vals[4])) if len(vals) > 4 else {}
+            now = time.time()
+            with self._lock:
+                cur = self._leases.get(group)
+                if (
+                    cur is not None
+                    and cur["holder"] != holder
+                    and float(cur["expires"]) > now
+                ):
+                    return wire.encode("lease", ["null"])
+                term = int(cur["term"]) if cur is not None else 0
+                if cur is None or cur["holder"] != holder:
+                    term += 1
+                term = max(term, min_term)
+                new = {"term": term, "holder": holder,
+                       "expires": now + ttl, "meta": meta}
+                self._leases[group] = new
+                return wire.encode("lease", [self._lease_json(new)])
+        if op == "lease_renew":
+            group, holder = str(vals[0]), str(vals[1])
+            term, ttl = int(vals[2]), float(vals[3])
+            with self._lock:
+                cur = self._leases.get(group)
+                ok = (
+                    cur is not None
+                    and cur["holder"] == holder
+                    and int(cur["term"]) == term
+                )
+                if ok:
+                    cur["expires"] = time.time() + ttl
+            return wire.encode("ok", [bool(ok)])
+        if op == "lease_observe":
+            with self._lock:
+                cur = self._leases.get(str(vals[0]))
+                out = "null" if cur is None else self._lease_json(cur)
+            return wire.encode("lease", [out])
         return wire.encode("err", [f"unknown op {op!r}"])
+
+    @staticmethod
+    def _lease_json(lease: dict) -> str:
+        # expires_in is RELATIVE — client and server clocks never compared
+        return json.dumps({
+            "term": int(lease["term"]),
+            "holder": lease["holder"],
+            "expires_in": float(lease["expires"]) - time.time(),
+            "meta": lease.get("meta") or {},
+        })
 
 
 class TcpRegistry:
@@ -167,12 +229,15 @@ class TcpRegistry:
         (ephemeral-znode + session keep-alive parity)."""
         stop = threading.Event()
 
-        meta_json = json.dumps(meta or {})
-
         def beat():
             while not stop.is_set():
                 try:
-                    self._call("reg", [shard, host, port, meta_json])
+                    # meta is re-serialized EVERY beat (file-backend
+                    # parity): replication coordinators mutate the dict
+                    # in place so peers see live WAL positions/roles
+                    self._call(
+                        "reg", [shard, host, port, json.dumps(meta or {})]
+                    )
                 except (OSError, RuntimeError):
                     # rendezvous briefly away or replying err frames
                     # (e.g. mid-restart): keep beating — a dead heartbeat
@@ -211,6 +276,54 @@ class TcpRegistry:
             (int(s), str(h), int(p)): json.loads(m[0]) if m else {}
             for s, h, p, *m in json.loads(table_json)
         }
+
+    def members(self, shard: int) -> list[tuple[str, int, dict]]:
+        """Live (host, port, meta) entries for one shard group — the
+        replica-group view promotion reads peer positions from. Empty on
+        a transport fault (the rendezvous mid-restart): callers treat
+        that as 'membership unknown', not 'everyone is dead'."""
+        try:
+            table = self.lookup_meta()
+        except (OSError, RuntimeError):
+            return []
+        return [
+            (h, p, meta)
+            for (s, h, p), meta in sorted(table.items())
+            if s == int(shard)
+        ]
+
+    # -- leases (PR 13 replication) --------------------------------------
+
+    def acquire_lease(self, group: str, holder: str, ttl: float,
+                      meta: dict | None = None,
+                      min_term: int = 0) -> dict | None:
+        """Take the group's lease (free/expired/already ours); a NEW
+        holder bumps the term. `min_term` floors the granted term so a
+        rendezvous restart (in-memory lease lost) cannot rewind the
+        fencing clock. None when another holder's lease is live.
+        Transport faults raise (OSError/ConnectionError) — the caller's
+        lease logic must not mistake a dead registry for a free lease."""
+        (lease_json,) = self._call(
+            "lease_acquire",
+            [group, holder, float(ttl), int(min_term),
+             json.dumps(meta or {})],
+        )
+        lease = json.loads(lease_json)
+        return lease if lease else None
+
+    def renew(self, group: str, holder: str, term: int,
+              ttl: float) -> bool:
+        """Extend the lease — only while holder AND term still match."""
+        (ok,) = self._call(
+            "lease_renew", [group, holder, int(term), float(ttl)]
+        )
+        return bool(ok)
+
+    def observe(self, group: str) -> dict | None:
+        """Current lease ({term, holder, expires_in, meta}) or None."""
+        (lease_json,) = self._call("lease_observe", [group])
+        lease = json.loads(lease_json)
+        return lease if lease else None
 
     def wait_for(self, num_shards: int, timeout: float = 30.0):
         deadline = time.time() + timeout

@@ -1,0 +1,547 @@
+"""Replicated graph shard groups — primary/backup over shipped WAL bytes.
+
+Euler 2.0 serves each shard from multiple replicas under ZooKeeper
+membership (PAPER.md L2/L4b); this is that availability story for the
+mutable shards PRs 8-10 built. One replica group per shard: the PRIMARY
+holds a term-numbered, TTL-renewed lease in the registry and is the only
+member that accepts mutations; FOLLOWERS tail its WAL over the
+`wal_ship` verb, append the raw record bytes verbatim, and replay them
+through the same `graph/wal.py` staging/merge code — so every replica's
+log is byte-identical, logical offsets are interchangeable, and the
+stores are bit-identical by construction (the repo's determinism
+discipline doing the heavy lifting Chain-Replication/Raft papers spend
+pages on).
+
+Roles and safety:
+
+  lease    — `registry.acquire_lease("shard_<i>", "host:port", ttl)`;
+             a NEW holder bumps the term. The primary renews every
+             ttl/3 and considers itself fenced once (monotonic time of
+             the last successful renew) + ttl passes — strictly before
+             the server-side expiry any follower promotes on.
+  fencing  — every mutation gates on `check_primary()`: followers and
+             fenced ex-primaries answer the typed `NotPrimaryError`
+             naming the current primary, which `GraphWriter` uses to
+             re-route its keyed outbox (idempotency keys make the
+             retry exactly-once across the failover). WAL records are
+             term-stamped (`wal.wrap_term`), so divergent history is
+             diagnosable from the log alone.
+  election — on lease expiry, the live follower with the highest
+             durable WAL position promotes (tie → lowest replica id),
+             acquiring the lease with min_term = last-seen term + 1 so
+             even a wiped registry cannot rewind the fencing clock.
+             Peer positions come from registry heartbeat meta, which
+             every member republishes live.
+  quorum   — EULER_TPU_REPL_ACK=quorum (default) holds each mutation
+             ack until ⌈R/2⌉ followers have durably shipped past the
+             record (their next `wal_ship` from_pos is the implicit
+             ack); `async` acks after the primary's fsync alone
+             (windows of un-replicated tail may be discarded on
+             failover); `off` additionally skips position bookkeeping.
+  history  — each ship request carries a crc of the follower's log
+             tail; a mismatch (an ex-primary holding never-replicated
+             records) or a trimmed prefix makes the primary answer
+             need_snapshot, and the follower re-bootstraps from the
+             primary's newest publish-consistent snapshot over the
+             wire, then the WAL suffix.
+
+The coordinator is two daemon threads per replica: a lease loop (renew /
+observe / elect) and a tail loop (ship / apply / bootstrap). Everything
+observable rides the three deterministic verbs `wal_ship` / `wal_pos` /
+`repl_status` (tables + dispatch arms + runtime twins per house rules).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+from euler_tpu.distributed import wire
+from euler_tpu.distributed.errors import (
+    NotPrimaryError,
+    OverloadError,
+    RpcError,
+    from_wire,
+)
+
+# load-bearing verb table (wire-protocol checker + runtime parity twin):
+# every verb this module puts on the wire
+WIRE_VERBS = frozenset({
+    "repl_status",
+    "wal_pos",
+    "wal_ship",
+})
+
+
+def ack_mode() -> str:
+    """quorum | async | off (EULER_TPU_REPL_ACK, default quorum)."""
+    mode = os.environ.get("EULER_TPU_REPL_ACK", "quorum").strip().lower()
+    return mode if mode in ("quorum", "async", "off") else "quorum"
+
+
+def lease_ttl_default() -> float:
+    return float(os.environ.get("EULER_TPU_LEASE_TTL_S", "5.0"))
+
+
+def _parse_addr(holder: str) -> tuple[str, int] | None:
+    host, _, port = str(holder).rpartition(":")
+    try:
+        return (host, int(port)) if host else None
+    except ValueError:
+        return None
+
+
+class _PrimaryLink:
+    """One follower→primary connection (single-threaded: the tail loop
+    owns it). Speaks the standard frame protocol; err frames surface as
+    the typed exceptions the rest of the stack expects."""
+
+    def __init__(self, host: str, port: int):
+        self.host = str(host)
+        self.port = int(port)
+        self._sock: socket.socket | None = None
+
+    def _call(self, op: str, values: list, timeout_s: float | None = None):
+        to = (
+            timeout_s if timeout_s is not None
+            else float(os.environ.get("EULER_TPU_SHIP_TIMEOUT_S", "10.0"))
+        )
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=to
+            )
+            self._sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+        self._sock.settimeout(to)
+        wire.send_frame(self._sock, wire.encode_vectored(op, values))
+        payload = wire.read_frame(self._sock)
+        if payload is None:
+            raise ConnectionError("connection closed by peer")
+        status, result = wire.decode(payload, borrow=True)
+        if status == "err":
+            raise from_wire(result[0])
+        return result
+
+    def close(self):
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class ReplicaCoordinator:
+    """Per-replica state machine: lease renewal, WAL tailing, election,
+    and quorum-ack accounting for one GraphService."""
+
+    def __init__(
+        self,
+        service,
+        registry,
+        replica_id: int,
+        group_size: int,
+        lease_ttl: float | None = None,
+    ):
+        self.service = service
+        self.registry = registry
+        self.rid = int(replica_id)
+        self.group_size = max(int(group_size), 1)
+        self.ttl = float(
+            lease_ttl if lease_ttl is not None else lease_ttl_default()
+        )
+        self.group = f"shard_{service.shard}"
+        self.role = "follower"
+        self.term = 0
+        self.primary_addr: tuple[str, int] | None = None
+        # monotonic fencing clock: mutations are accepted only while
+        # now < _lease_ok_until. The deadline is stamped from a time
+        # captured BEFORE the successful acquire/renew RPC, so it is
+        # always ≤ the server-side expiry a follower promotes on.
+        self._lease_ok_until = 0.0
+        self.ack_mode = ack_mode()
+        self.ack_timeout = float(
+            os.environ.get("EULER_TPU_REPL_ACK_TIMEOUT_S", "30.0")
+        )
+        # heartbeat meta: mutated IN PLACE — both registry backends
+        # re-serialize it every beat, so peers read live positions
+        self.heartbeat_meta = {
+            "replica": self.rid, "role": self.role, "pos": 0, "term": 0,
+        }
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # primary side: follower rid → durable shipped position
+        self._pos_cond = threading.Condition()
+        self._positions: dict[int, int] = {}
+        # shippers long-poll on this for the next committed record
+        self._ship_cond = threading.Condition()
+        self._link: _PrimaryLink | None = None
+        # telemetry (GIL-racy increments fine — repo counter stance)
+        self.promotions = 0
+        self.demotions = 0
+        self.bootstraps = 0
+        self.ship_batches = 0
+        self.ship_bytes = 0
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        for name, fn in (
+            ("lease", self._lease_loop), ("tail", self._tail_loop)
+        ):
+            t = threading.Thread(
+                target=fn, daemon=True,
+                name=f"shard{self.service.shard}-r{self.rid}-{name}",
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._ship_cond:
+            self._ship_cond.notify_all()
+        with self._pos_cond:
+            self._pos_cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self._drop_link()
+
+    # -- service-facing hooks --------------------------------------------
+
+    def check_primary(self) -> None:
+        """Raise NotPrimaryError unless this replica holds a live lease."""
+        if self.role == "primary":
+            if time.monotonic() < self._lease_ok_until:
+                return
+            role = "fenced"  # deposed-or-partitioned ex-primary
+            primary = None
+        else:
+            role = self.role
+            primary = self.primary_addr
+        raise NotPrimaryError(
+            NotPrimaryError.format(
+                self.service.shard, role, self.term, primary
+            )
+        )
+
+    def after_commit(self, pos: int) -> None:
+        """Called by the primary after each WAL group-commit: wake
+        long-polling shippers, then (quorum mode) hold this ack until
+        ⌈R/2⌉ followers have durably shipped past `pos`."""
+        with self._ship_cond:
+            self._ship_cond.notify_all()
+        if self.ack_mode != "quorum" or self.group_size <= 1:
+            return
+        needed = min((self.group_size + 1) // 2, self.group_size - 1)
+        deadline = time.monotonic() + self.ack_timeout
+        with self._pos_cond:
+            while (
+                sum(1 for p in self._positions.values() if p >= pos)
+                < needed
+            ):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise OverloadError(
+                        f"quorum ack timeout: {needed} follower ack(s)"
+                        f" past pos {pos} not reached within"
+                        f" {self.ack_timeout}s"
+                        f" (followers at {dict(self._positions)})"
+                    )
+                self._pos_cond.wait(min(left, 0.1))
+
+    def note_follower(self, rid: int, pos: int) -> None:
+        """A ship request's from_pos IS the follower's durable ack."""
+        if rid == self.rid:
+            return
+        with self._pos_cond:
+            if pos > self._positions.get(rid, -1):
+                self._positions[rid] = int(pos)
+                self._pos_cond.notify_all()
+
+    def wait_for_append(self, from_pos: int, timeout_s: float) -> None:
+        """Server-side long poll: block (briefly) until the log grows
+        past `from_pos` or the timeout lapses."""
+        wal = self.service._wal
+        if wal is None:
+            return
+        with self._ship_cond:
+            if wal.tell() > from_pos or self._stop.is_set():
+                return
+            self._ship_cond.wait(max(min(timeout_s, 1.0), 0.0))
+
+    def status(self) -> dict:
+        with self._pos_cond:
+            followers = {
+                str(k): int(v) for k, v in sorted(self._positions.items())
+            }
+        pa = self.primary_addr
+        return {
+            "role": self.role,
+            "term": int(self.term),
+            "replica": self.rid,
+            "group_size": self.group_size,
+            "primary": f"{pa[0]}:{pa[1]}" if pa else None,
+            "ack_mode": self.ack_mode,
+            "followers": followers,
+            "promotions": self.promotions,
+            "demotions": self.demotions,
+            "bootstraps": self.bootstraps,
+        }
+
+    # -- lease state machine ---------------------------------------------
+
+    def _holder_str(self) -> str:
+        return f"{self.service.host}:{self.service.port}"
+
+    def _my_pos(self) -> int:
+        wal = self.service._wal
+        return int(wal.tell()) if wal is not None else 0
+
+    def _lease_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._lease_step()
+            except Exception:  # the loop must outlive any one bad step
+                pass
+            m = self.heartbeat_meta
+            m["role"] = self.role
+            m["term"] = int(self.term)
+            if self.role == "primary":
+                m["pos"] = self._my_pos()
+            self._stop.wait(min(self.ttl / 3.0, 0.5))
+
+    def _lease_step(self):
+        holder = self._holder_str()
+        if self.role == "primary":
+            t0 = time.monotonic()
+            try:
+                ok = self.registry.renew(
+                    self.group, holder, self.term, self.ttl
+                )
+            except (OSError, RuntimeError, ConnectionError, TimeoutError):
+                # registry unreachable: keep serving until the fencing
+                # clock (set from the LAST successful renew) runs out —
+                # never mistake a dead registry for a lost lease, and
+                # never outlive the window a follower may promote in
+                return
+            if ok:
+                self._lease_ok_until = t0 + self.ttl
+                return
+            # renew refused: superseded, or the lease record is gone
+            lease = self._observe()
+            if lease is not None and lease["holder"] != holder:
+                self._demote(lease)
+                return
+            got = self._try_acquire(min_term=self.term, t0=t0)
+            if got is None and time.monotonic() >= self._lease_ok_until:
+                self._demote(lease)
+            elif got is not None:
+                self._adopt_primary(got)
+            return
+        # follower path
+        lease = self._observe()
+        if lease is not None and float(lease["expires_in"]) > 0:
+            if lease["holder"] == holder:
+                self._adopt_primary(lease)
+            else:
+                self.term = max(self.term, int(lease["term"]))
+                self.primary_addr = _parse_addr(lease["holder"])
+            return
+        self._elect(lease)
+
+    def _observe(self):
+        try:
+            return self.registry.observe(self.group)
+        except (OSError, RuntimeError, ConnectionError, TimeoutError):
+            return None
+
+    def _try_acquire(self, min_term: int, t0: float | None = None):
+        t0 = time.monotonic() if t0 is None else t0
+        try:
+            lease = self.registry.acquire_lease(
+                self.group, self._holder_str(), self.ttl,
+                meta={"replica": self.rid}, min_term=int(min_term),
+            )
+        except (OSError, RuntimeError, ConnectionError, TimeoutError):
+            return None
+        if lease is not None:
+            lease = dict(lease)
+            lease["_t0"] = t0
+        return lease
+
+    def _elect(self, lapsed_lease):
+        """The lease is absent or expired: promote if no live peer is a
+        strictly better candidate — higher durable position, tie broken
+        by lower replica id. The lapsed holder itself is excluded (it is
+        suspected dead; if it is alive it re-acquires under its own
+        min_term floor). Peer positions are heartbeat-meta reads, so a
+        better-but-dead peer delays promotion at most one heartbeat TTL."""
+        if lapsed_lease is not None:
+            self.term = max(self.term, int(lapsed_lease["term"]))
+        dead_holder = (
+            lapsed_lease["holder"] if lapsed_lease is not None else None
+        )
+        try:
+            peers = self.registry.members(self.service.shard)
+        except (OSError, RuntimeError, ConnectionError, TimeoutError):
+            return
+        me = (self._my_pos(), -self.rid)
+        for host, port, meta in peers:
+            addr = f"{host}:{int(port)}"
+            if addr == self._holder_str() or addr == dead_holder:
+                continue
+            try:
+                cand = (
+                    int(meta.get("pos", 0)),
+                    -int(meta.get("replica", 1 << 30)),
+                )
+            except (TypeError, ValueError, AttributeError):
+                continue
+            if cand > me:
+                return  # a better candidate is live; let it promote
+        got = self._try_acquire(min_term=self.term + 1)
+        if got is not None:
+            self._adopt_primary(got)
+
+    def _adopt_primary(self, lease):
+        promoted = self.role != "primary"
+        self.term = max(self.term, int(lease["term"]))
+        self.role = "primary"
+        self.primary_addr = (self.service.host, self.service.port)
+        t0 = float(lease.get("_t0", time.monotonic()))
+        self._lease_ok_until = t0 + self.ttl
+        if promoted:
+            self.promotions += 1
+            self._drop_link()
+            with self._pos_cond:
+                # followers re-ack against THIS log; stale positions
+                # from the previous reign must not satisfy a quorum
+                self._positions.clear()
+                self._pos_cond.notify_all()
+
+    def _demote(self, lease):
+        if self.role == "primary":
+            self.demotions += 1
+        self.role = "follower"
+        self._lease_ok_until = 0.0
+        if lease is not None:
+            self.term = max(self.term, int(lease["term"]))
+            self.primary_addr = _parse_addr(lease["holder"])
+        else:
+            self.primary_addr = None
+
+    # -- follower tail loop ----------------------------------------------
+
+    def _get_link(self, addr: tuple[str, int]) -> _PrimaryLink:
+        link = self._link
+        if (
+            link is None
+            or (link.host, link.port) != (addr[0], int(addr[1]))
+        ):
+            self._drop_link()
+            link = self._link = _PrimaryLink(addr[0], addr[1])
+        return link
+
+    def _drop_link(self):
+        link, self._link = self._link, None
+        if link is not None:
+            link.close()
+
+    def _tail_loop(self):
+        max_bytes = int(
+            os.environ.get("EULER_TPU_SHIP_MAX_BYTES", str(1 << 20))
+        )
+        poll_ms = float(os.environ.get("EULER_TPU_SHIP_POLL_MS", "100.0"))
+        while not self._stop.is_set():
+            if self.role != "follower":
+                self._stop.wait(0.05)
+                continue
+            addr = self.primary_addr
+            if addr is None or addr == (
+                self.service.host, self.service.port
+            ):
+                self._stop.wait(0.05)
+                continue
+            try:
+                self._tail_once(addr, max_bytes, poll_ms)
+            except (OSError, ConnectionError, ValueError, RuntimeError):
+                # transport fault / primary died / local log raced a
+                # role change: drop the link, re-observe, retry
+                self._drop_link()
+                self._stop.wait(0.1)
+
+    def _tail_once(self, addr, max_bytes: int, poll_ms: float):
+        link = self._get_link(addr)
+        pos, crc, clen = self.service.wal_tail_probe()
+        try:
+            reply = link._call(
+                "wal_ship",
+                [pos, max_bytes, self.rid, "log", crc, clen, poll_ms],
+            )
+        except RpcError:
+            # typed server verdict (e.g. the peer has no WAL, or an old
+            # peer without the verb): back off, the lease loop decides
+            self._drop_link()
+            self._stop.wait(0.2)
+            return
+        term, data, end, need = (
+            int(reply[0]), reply[1], int(reply[2]), bool(reply[3])
+        )
+        if term < self.term:
+            # a fenced ex-primary still answering its old connections:
+            # its records must not enter our log
+            self._drop_link()
+            self._stop.wait(0.2)
+            return
+        self.term = max(self.term, term)
+        if need:
+            self._bootstrap(link)
+            return
+        blob = bytes(np.ascontiguousarray(data)) if len(data) else b""
+        if blob:
+            newpos = self.service.apply_shipped(blob, pos)
+            self.ship_batches += 1
+            self.ship_bytes += len(blob)
+            self.heartbeat_meta["pos"] = int(newpos)
+        else:
+            self.heartbeat_meta["pos"] = int(pos)
+
+    def _bootstrap(self, link: _PrimaryLink):
+        """Install the primary's newest publish-consistent snapshot over
+        the wire, then resume tailing its WAL suffix. When the primary
+        has no snapshot but a complete log (base 0), fall back to the
+        construction-time dataset partition and replay from 0."""
+        from euler_tpu.graph import wal as walmod
+
+        to = float(os.environ.get("EULER_TPU_BOOTSTRAP_TIMEOUT_S", "60.0"))
+        try:
+            reply = link._call(
+                "wal_ship", [0, 0, self.rid, "snapshot"], timeout_s=to
+            )
+        except RpcError:
+            t, base, end, _ep = link._call("wal_pos", [])
+            if int(base) == 0:
+                self.service.reset_to_source()
+                self.heartbeat_meta["pos"] = 0
+                self.bootstraps += 1
+                return
+            raise
+        term, epoch, wal_pos = int(reply[0]), int(reply[1]), int(reply[2])
+        applied = walmod._applied_from_blob(
+            bytes(np.ascontiguousarray(reply[3]))
+        )
+        names = json.loads(reply[4])
+        arrays = {
+            n: np.array(a, copy=True) for n, a in zip(names, reply[5:])
+        }
+        self.service.install_snapshot(epoch, arrays, applied, wal_pos)
+        self.term = max(self.term, term)
+        self.bootstraps += 1
+        self.heartbeat_meta["pos"] = int(wal_pos)

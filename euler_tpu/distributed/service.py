@@ -24,6 +24,7 @@ import time
 import numpy as np
 
 from euler_tpu.distributed import chaos, wire
+from euler_tpu.distributed.errors import RpcError
 from euler_tpu.distributed.registry import Registry
 from euler_tpu.distributed.rendezvous import make_registry
 from euler_tpu.graph import format as tformat
@@ -379,6 +380,9 @@ class GraphService:
         registry: Registry | None = None,
         workers: int | None = None,
         wal_dir: str | None = None,
+        replica: int | None = None,
+        group_size: int = 1,
+        lease_ttl: float | None = None,
     ):
         self.store = store
         self.meta = meta
@@ -437,15 +441,48 @@ class GraphService:
         # updates race benignly across pool workers — it is telemetry,
         # not an invariant.
         self.op_counts: collections.Counter = collections.Counter()
+        # replication (distributed/replication.py): with replica=,
+        # this shard is one member of a replica group — a coordinator
+        # runs the lease/tail/promotion state machine, mutations gate on
+        # primaryship, and acks honor EULER_TPU_REPL_ACK. Solo shards
+        # (replica=None) keep every pre-PR-13 behavior byte-for-byte.
+        self._repl = None
+        # the pristine construction-time store: a follower whose history
+        # diverged past the primary's oldest snapshot re-syncs from here
+        # (identical across replicas — same dataset partition)
+        self._source_store = store
+        if replica is not None:
+            if registry is None or wal_dir is None:
+                raise ValueError(
+                    "replication needs registry= (leases/membership)"
+                    " and wal_dir= (the shipped log)"
+                )
+            from euler_tpu.distributed.replication import (
+                ReplicaCoordinator,
+            )
+
+            self._repl = ReplicaCoordinator(
+                self, registry, replica_id=int(replica),
+                group_size=int(group_size), lease_ttl=lease_ttl,
+            )
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self):
         self.server.start()
         if self.registry is not None:
+            # a replicated shard heartbeats the coordinator's live meta
+            # dict (replica id, role, shipped position, term) — what
+            # peers read during promotion
             self._beat = self.registry.register(
-                self.shard, self.host, self.port
+                self.shard, self.host, self.port,
+                meta=(
+                    self._repl.heartbeat_meta
+                    if self._repl is not None else None
+                ),
             )
+        if self._repl is not None:
+            self._repl.start()
         return self
 
     def stop(self, drain_s: float | None = None):
@@ -453,6 +490,8 @@ class GraphService:
         registry FIRST (clients stop routing here), refuse new
         connections, finish in-flight work (bounded by drain_s), then
         close. drain_s=None keeps the immediate-stop behavior."""
+        if self._repl is not None:
+            self._repl.stop()
         if self._beat is not None:
             self._beat.set()
         if drain_s:
@@ -532,6 +571,7 @@ class GraphService:
         "ping",
         "publish_epoch",
         "random_walk",
+        "repl_status",
         "sage_minibatch",
         "sample_edge",
         "sample_edge_with_condition",
@@ -545,6 +585,8 @@ class GraphService:
         "unit_edge_weights",
         "upsert_edges",
         "upsert_nodes",
+        "wal_pos",
+        "wal_ship",
     })
 
     def is_coordinator(self, op: str) -> bool:
@@ -586,6 +628,22 @@ class GraphService:
                 "last_snapshot_epoch": self._last_snapshot_epoch,
                 "recovering": bool(self.recovering),
             })]
+        if op == "repl_status":
+            # replication introspection: role/term/position/primary —
+            # the writer's primary-discovery verb and the ops dashboard
+            # row. Deterministic given the coordinator's state; solo
+            # (un-replicated) shards answer role="solo".
+            return [json.dumps(self.repl_status())]
+        if op == "wal_pos":
+            # [term, wal_base, wal_end, graph_epoch] — the cheap
+            # position probe promotion and catch-up monitoring poll
+            return self._wal_pos()
+        if op == "wal_ship":
+            # the follower tail verb: [from_pos, max_bytes, replica_id,
+            # want, tail_crc, tail_len, poll_ms] → raw record bytes (or
+            # snapshot state for bootstrap). The from_pos doubles as the
+            # follower's durable-ack position (quorum accounting).
+            return self._wal_ship(a)
         if op == "upsert_nodes":
             return self._stage_mutation(op, a)
         if op == "upsert_edges":
@@ -848,8 +906,16 @@ class GraphService:
         group-committed fsync."""
         from euler_tpu.graph import wal as walmod
 
+        # replica groups: only the live-leased primary may stage. A
+        # follower (or a fenced ex-primary past its lease) answers the
+        # typed NotPrimaryError naming the current primary — the
+        # writer's redirect signal. The gate sits BEFORE any state
+        # changes, so a rejected write leaves nothing behind.
+        if self._repl is not None:
+            self._repl.check_primary()
         key = str(a[0])
         seq = None
+        pos = None
         with self._delta_lock:
             hit = self._applied.get(key)
             if hit is not None:
@@ -864,7 +930,15 @@ class GraphService:
             n = walmod.stage_record(delta, op, a)
             if self._wal is not None:
                 try:
-                    seq, _ = self._wal.write(op, a)
+                    # records carry the primary's term — replay unwraps
+                    # it, and fencing proofs read it back
+                    seq, pos = self._wal.write(
+                        op, a,
+                        term=(
+                            self._repl.term
+                            if self._repl is not None else 0
+                        ),
+                    )
                 except OSError:
                     # disk full/IO error AFTER the rows staged (no roll
                     # back): record the key so a retry can't double-apply
@@ -878,6 +952,11 @@ class GraphService:
                 self._applied.popitem(last=False)
         if seq is not None:
             self._wal.commit(seq)
+        if self._repl is not None and pos is not None:
+            # quorum mode: the ack leaves only after ⌈R/2⌉ followers
+            # have durably shipped past this record (async/off: the
+            # notify still wakes long-polling shippers, no wait)
+            self._repl.after_commit(pos)
         return [n, True]
 
     def _publish_epoch(self, key) -> list:
@@ -889,7 +968,10 @@ class GraphService:
         None row/id sets tell the client to fully flush its cache (used
         for oversized stale sets and for retried publishes whose first
         response was lost)."""
+        if self._repl is not None:
+            self._repl.check_primary()
         seq = None
+        pos = None
         snapshot_due = False
         with self._delta_lock:
             if key is not None:
@@ -898,62 +980,93 @@ class GraphService:
                     # retried publish: the merge already happened; replay
                     # the recorded outcome instead of merging again
                     return list(hit)
-            delta, self._delta = self._delta, None
-            store = self.store
-            if delta is None or delta.empty:
-                result = [
-                    int(getattr(store, "graph_epoch", 0)),
-                    np.empty(0, np.int64),
-                    np.empty(0, np.uint64),
-                    int(store.num_nodes),
-                ]
-            else:
-                new_store, rows, ids = store.merge_delta(delta)
-                self.store = new_store
-                # the cluster facade binds the old store object; patch it
-                # so coordinator ops (exec_plan/sample_fanout) serve the
-                # new epoch too
-                with self._cluster_lock:
-                    g = self._cluster_g
-                    if g is not None:
-                        for i, sh in enumerate(g.shards):
-                            if sh is store:
-                                g.shards[i] = self.store
-                        g.refresh_shard_weights()
-                if len(rows) + len(ids) > self.PUBLISH_RESULT_CAP:
-                    rows = ids = None  # client falls back to a full flush
-                result = [
-                    int(self.store.graph_epoch),
-                    rows,
-                    ids,
-                    int(self.store.num_nodes),
-                ]
-            if key is not None:
-                self._applied[f"pub:{key}"] = tuple(result)
-                while len(self._applied) > self.APPLIED_KEYS_MAX:
-                    self._applied.popitem(last=False)
+            result = self._merge_delta_locked(key)
             if self._wal is not None:
-                seq, pos = self._wal.write("publish_epoch", [key])
-                self._publish_count += 1
-                # the ONLY WAL positions a snapshot may cover: here the
-                # store, the applied window, and the log position agree
-                # (staged-but-unpublished records all sit past `pos`)
-                self._snap_state = (
-                    self.store,
-                    collections.OrderedDict(self._applied),
-                    pos,
+                seq, pos = self._wal.write(
+                    "publish_epoch", [key],
+                    term=(
+                        self._repl.term if self._repl is not None else 0
+                    ),
                 )
-                from euler_tpu.graph.wal import snapshot_every
-
-                every = snapshot_every()
-                snapshot_due = bool(
-                    every and self._publish_count % every == 0
-                )
+                snapshot_due = self._note_publish_locked(pos)
         if seq is not None:
             self._wal.commit(seq)
+        if self._repl is not None and pos is not None:
+            self._repl.after_commit(pos)
         if snapshot_due:
             self._spawn_snapshot()
         return result
+
+    def _merge_delta_locked(self, key) -> list:
+        """Merge the staged delta and swap self.store in one reference
+        assignment (caller holds _delta_lock). Shared by the primary
+        publish path and the follower's shipped-publish replay, so both
+        compute the identical store and record the identical outcome
+        under `pub:<key>` — a publish retried across a failover replays
+        the same answer on the new primary."""
+        # graftlint: disable=lock-mixed-write -- every caller holds self._delta_lock (the _locked suffix contract)
+        delta, self._delta = self._delta, None
+        store = self.store
+        if delta is None or delta.empty:
+            result = [
+                int(getattr(store, "graph_epoch", 0)),
+                np.empty(0, np.int64),
+                np.empty(0, np.uint64),
+                int(store.num_nodes),
+            ]
+        else:
+            new_store, rows, ids = store.merge_delta(delta)
+            # graftlint: disable=lock-mixed-write -- every caller holds self._delta_lock (the _locked suffix contract)
+            self.store = new_store
+            # the cluster facade binds the old store object; patch it
+            # so coordinator ops (exec_plan/sample_fanout) serve the
+            # new epoch too
+            self._swap_cluster_store(store)
+            if len(rows) + len(ids) > self.PUBLISH_RESULT_CAP:
+                rows = ids = None  # client falls back to a full flush
+            result = [
+                int(self.store.graph_epoch),
+                rows,
+                ids,
+                int(self.store.num_nodes),
+            ]
+        if key is not None:
+            # graftlint: disable=lock-mixed-write -- every caller holds self._delta_lock (the _locked suffix contract)
+            self._applied[f"pub:{key}"] = tuple(result)
+            while len(self._applied) > self.APPLIED_KEYS_MAX:
+                # graftlint: disable=lock-mixed-write -- every caller holds self._delta_lock (the _locked suffix contract)
+                self._applied.popitem(last=False)
+        return result
+
+    def _swap_cluster_store(self, old_store) -> None:
+        """Re-point the cluster facade's local-shard slot at the current
+        self.store (the facade bound the old object at build time)."""
+        with self._cluster_lock:
+            g = self._cluster_g
+            if g is not None:
+                for i, sh in enumerate(g.shards):
+                    if sh is old_store:
+                        g.shards[i] = self.store
+                g.refresh_shard_weights()
+
+    def _note_publish_locked(self, pos: int) -> bool:
+        """Record a publish at WAL position `pos` (caller holds
+        _delta_lock): capture the snapshot-eligible state and answer
+        whether the snapshot cadence is due."""
+        self._publish_count += 1
+        # the ONLY WAL positions a snapshot may cover: here the
+        # store, the applied window, and the log position agree
+        # (staged-but-unpublished records all sit past `pos`)
+        # graftlint: disable=lock-mixed-write -- every caller holds self._delta_lock (the _locked suffix contract)
+        self._snap_state = (
+            self.store,
+            collections.OrderedDict(self._applied),
+            pos,
+        )
+        from euler_tpu.graph.wal import snapshot_every
+
+        every = snapshot_every()
+        return bool(every and self._publish_count % every == 0)
 
     # -- snapshots (graph/wal.py) ----------------------------------------
 
@@ -1008,6 +1121,217 @@ class GraphService:
         self._snap_busy.acquire()
         self._snapshot_run()
         return True
+
+    # -- replication (distributed/replication.py) ------------------------
+
+    def repl_status(self) -> dict:
+        """Role/term/position view of this replica — the `repl_status`
+        verb body. Solo (un-replicated) shards answer role="solo" so
+        writers know there is no primary to discover."""
+        st = {
+            "shard": self.shard,
+            "role": "solo",
+            "term": 0,
+            "replica": None,
+            "group_size": 1,
+            "primary": None,
+            "ack_mode": None,
+            "wal_base": int(self._wal.base) if self._wal else 0,
+            "wal_end": int(self._wal.tell()) if self._wal else 0,
+            "graph_epoch": int(getattr(self.store, "graph_epoch", 0)),
+        }
+        if self._repl is not None:
+            st.update(self._repl.status())
+        return st
+
+    def _wal_pos(self) -> list:
+        """[term, wal_base, wal_end, graph_epoch]."""
+        return [
+            int(self._repl.term) if self._repl is not None else 0,
+            int(self._wal.base) if self._wal is not None else 0,
+            int(self._wal.tell()) if self._wal is not None else 0,
+            int(getattr(self.store, "graph_epoch", 0)),
+        ]
+
+    def wal_tail_probe(self, window: int = 4096) -> tuple[int, int, int]:
+        """(end_pos, tail_crc, tail_len) of this replica's own log — the
+        continuity handshake a follower offers with each ship request."""
+        if self._wal is None:
+            return 0, 0, 0
+        pos = self._wal.tell()
+        n = min(int(window), pos - self._wal.base)
+        if n <= 0:
+            return pos, 0, 0
+        return pos, self._wal.crc_range(pos - n, pos), n
+
+    def _wal_ship(self, a: list) -> list:
+        """Serve one follower tail request.
+
+        args: [from_pos, max_bytes, replica_id, want, tail_crc,
+        tail_len, poll_ms] (trailing args optional). Log mode answers
+        [term, record_bytes(u8), end_pos, need_snapshot]; snapshot mode
+        ([.., want="snapshot"]) answers the newest publish-consistent
+        state for bootstrap. `from_pos` is also the follower's durable
+        position — the primary's quorum accounting reads it from here.
+        need_snapshot=True when the prefix was trimmed, the follower is
+        AHEAD of this log, or the tail checksum mismatches (divergent
+        history — an ex-primary carrying never-replicated records)."""
+        from_pos = int(a[0])
+        max_bytes = int(a[1]) if len(a) > 1 and a[1] is not None else 1 << 20
+        rid = int(a[2]) if len(a) > 2 and a[2] is not None else None
+        want = str(a[3]) if len(a) > 3 and a[3] is not None else "log"
+        if rid is not None and self._repl is not None:
+            self._repl.note_follower(rid, from_pos)
+        if want == "snapshot":
+            return self._ship_snapshot()
+        if self._wal is None:
+            raise RpcError("wal_ship: this shard has no WAL (wal_dir)")
+        term = int(self._repl.term) if self._repl is not None else 0
+        tail_crc = int(a[4]) if len(a) > 4 and a[4] is not None else -1
+        tail_len = int(a[5]) if len(a) > 5 and a[5] is not None else 0
+        poll_ms = float(a[6]) if len(a) > 6 and a[6] is not None else 0.0
+        need = False
+        if from_pos < self._wal.base or from_pos > self._wal.tell():
+            need = True
+        elif tail_len > 0:
+            try:
+                mine = self._wal.crc_range(from_pos - tail_len, from_pos)
+                need = mine != (tail_crc & 0xFFFFFFFF)
+            except ValueError:
+                pass  # window partially trimmed here: snapshot covers it
+        if need:
+            return [term, np.empty(0, np.uint8), from_pos, True]
+        data, end = self._wal.read_raw(from_pos, max_bytes)
+        if not data and poll_ms > 0 and self._repl is not None:
+            # server-side long poll: wait briefly for the next commit so
+            # follower lag (and quorum ack latency) is ~one RTT + fsync,
+            # not a client polling interval
+            self._repl.wait_for_append(from_pos, poll_ms / 1e3)
+            data, end = self._wal.read_raw(from_pos, max_bytes)
+        return [
+            term,
+            np.frombuffer(data, np.uint8) if data else np.empty(0, np.uint8),
+            int(end),
+            False,
+        ]
+
+    def _ship_snapshot(self) -> list:
+        """Bootstrap payload: [term, epoch, wal_pos, applied_blob(u8),
+        names_json, *arrays] — the newest publish-consistent state (the
+        in-memory _snap_state when one exists, else the newest on-disk
+        snapshot)."""
+        from euler_tpu.graph import wal as walmod
+
+        term = int(self._repl.term) if self._repl is not None else 0
+        with self._delta_lock:
+            state = self._snap_state
+        if state is not None:
+            store, applied, pos = state
+            epoch, arrays = int(store.graph_epoch), store.arrays
+        else:
+            if self._wal is None or self.wal_dir is None:
+                raise RpcError("wal_ship: no snapshot state to ship")
+            snap = walmod.load_snapshot(self.wal_dir, self._wal.base)
+            if snap is None:
+                raise RpcError(
+                    "wal_ship: no usable snapshot (log starts at"
+                    f" {self._wal.base})"
+                )
+            epoch, arrays, applied, pos = snap
+            epoch = int(epoch)
+        names = sorted(arrays)
+        blob = bytes(walmod._applied_blob(applied))
+        return [
+            term, epoch, int(pos),
+            np.frombuffer(blob, np.uint8),
+            json.dumps(names),
+        ] + [np.ascontiguousarray(arrays[n]) for n in names]
+
+    def apply_shipped(self, data: bytes, from_pos: int) -> int:
+        """Follower apply: verbatim-append a shipped record suffix and
+        replay it through the SAME staging/merge code the primary ran —
+        byte-identical logs and deterministic merges make every replica
+        bit-identical by construction. Returns the new durable position
+        (the implicit ack the next ship request carries)."""
+        from euler_tpu.graph import wal as walmod
+
+        records, valid_end = walmod.parse_records(data, from_pos)
+        if valid_end == from_pos:
+            return from_pos
+        blob = data[: valid_end - from_pos]
+        snapshot_due = False
+        with self._delta_lock:
+            have = self._wal.tell()
+            if have != from_pos:
+                raise RuntimeError(
+                    f"apply_shipped: log at {have}, shipped suffix"
+                    f" starts at {from_pos}"
+                )
+            # durable FIRST (fsync inside), apply second: a crash
+            # mid-apply replays the appended records from our own WAL
+            self._wal.append_raw(blob)
+            for op, a, end, _term in records:
+                if op == "publish_epoch":
+                    key = a[0] if a else None
+                    if not (
+                        key is not None
+                        and self._applied.get(f"pub:{key}") is not None
+                    ):
+                        self._merge_delta_locked(key)
+                        snapshot_due = (
+                            self._note_publish_locked(end) or snapshot_due
+                        )
+                    continue
+                key = str(a[0])
+                if self._applied.get(key) is not None:
+                    continue
+                if self._delta is None:
+                    from euler_tpu.graph.delta import DeltaStore
+
+                    self._delta = DeltaStore(
+                        self.shard, self.meta.num_partitions
+                    )
+                walmod.stage_record(self._delta, op, a)
+                self._applied[key] = True
+                while len(self._applied) > self.APPLIED_KEYS_MAX:
+                    self._applied.popitem(last=False)
+        if snapshot_due:
+            self._spawn_snapshot()
+        return valid_end
+
+    def install_snapshot(self, epoch, arrays, applied, wal_pos) -> None:
+        """Follower bootstrap: adopt a shipped publish-consistent state
+        wholesale and restart the local log at its position. A local
+        snapshot is written synchronously so a restart of THIS replica
+        recovers without re-bootstrapping over the wire."""
+        from euler_tpu.graph.store import GraphStore
+
+        with self._delta_lock:
+            old = self.store
+            store = GraphStore(self.meta, dict(arrays), self.shard)
+            store.graph_epoch = int(epoch)
+            self.store = store
+            self._swap_cluster_store(old)
+            self._delta = None
+            self._applied = collections.OrderedDict(applied)
+            self._wal.reset(int(wal_pos))
+            self._snap_state = (
+                store, collections.OrderedDict(self._applied), int(wal_pos)
+            )
+        self.snapshot_now()
+
+    def reset_to_source(self) -> None:
+        """Last-resort follower re-sync: back to the construction-time
+        dataset partition with an empty log — correct only when the
+        primary's log still starts at 0 (the caller checks)."""
+        with self._delta_lock:
+            old = self.store
+            self.store = self._source_store
+            self._swap_cluster_store(old)
+            self._delta = None
+            self._applied = collections.OrderedDict()
+            self._wal.reset(0)
+            self._snap_state = None
 
     def _sage_minibatch(
         self, batch_size, edge_types, counts, label, node_type, seed, lean
@@ -1110,13 +1434,20 @@ def serve_shard(
     native: bool | None = None,
     workers: int | None = None,
     wal_dir: str | None = None,
+    replica: int | None = None,
+    group_size: int = 1,
+    lease_ttl: float | None = None,
 ) -> GraphService:
     """Load shard `shard` of the dataset at data_dir and serve it.
 
     With `wal_dir`, the shard is DURABLE: boot first recovers from the
     newest snapshot + WAL-suffix replay (bit-identical to the pre-crash
     published epoch), then serves; every acked mutation is WAL-logged
-    before its response and snapshots run on the publish cadence."""
+    before its response and snapshots run on the publish cadence.
+
+    With `replica=` (+ registry + wal_dir), this process is one member
+    of shard's replica group: it contends for the group lease, serves
+    writes only as primary, and tails the primary's WAL otherwise."""
     meta = GraphMeta.load(data_dir)
     part_dir = os.path.join(data_dir, f"part_{shard}")
     arrays = tformat.read_arrays(part_dir)
@@ -1138,7 +1469,8 @@ def serve_shard(
     registry = make_registry(registry_path) if registry_path else None
     return GraphService(
         store, meta, shard, host, port, registry, workers=workers,
-        wal_dir=wal_dir,
+        wal_dir=wal_dir, replica=replica, group_size=group_size,
+        lease_ttl=lease_ttl,
     ).start()
 
 
@@ -1153,6 +1485,15 @@ def main(argv=None):
     ap.add_argument("--wal-dir", default=None,
                     help="durability dir (WAL + snapshots); boot recovers"
                          " from it, mutations fsync to it before the ack")
+    ap.add_argument("--replica", type=int, default=None,
+                    help="replica id within this shard's group (requires"
+                         " --registry and --wal-dir)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="replica group size R (quorum = ⌈R/2⌉ follower"
+                         " acks under EULER_TPU_REPL_ACK=quorum)")
+    ap.add_argument("--lease-ttl", type=float, default=None,
+                    help="primary lease TTL seconds (default from"
+                         " EULER_TPU_LEASE_TTL_S, else 5)")
     args = ap.parse_args(argv)
     svc = serve_shard(
         args.data,
@@ -1162,6 +1503,9 @@ def main(argv=None):
         args.registry,
         native=False if args.no_native else None,
         wal_dir=args.wal_dir,
+        replica=args.replica,
+        group_size=args.replicas,
+        lease_ttl=args.lease_ttl,
     )
     if svc.recovery_report and svc.recovery_report.get("recovered"):
         print(

@@ -298,6 +298,304 @@ class ShardSupervisor:
                     pass
 
 
+class _Member:
+    """Supervision state for one replica process of one shard group."""
+
+    def __init__(self, shard: int, rid: int, wal_dir: str):
+        self.shard = shard
+        self.rid = rid
+        self.wal_dir = wal_dir
+        self.port = 0  # fresh port at every (re)spawn
+        self.proc: subprocess.Popen | None = None
+        self.restarts = 0
+        self.window_restarts = 0
+        self.started_at = 0.0
+        self.next_spawn_at = 0.0
+        self.failed = False
+        self.log_path: str | None = None
+
+
+class ReplicaGroupSupervisor:
+    """Supervise R replicas per shard as lease-coordinated groups.
+
+    Where ShardSupervisor restarts ONE process per shard on a FIXED
+    port (clients hold static replica lists), this spawns `replication`
+    processes per shard, each a member of the shard's replica group
+    (`--replica i --replicas R`): one holds the lease and serves
+    writes, the rest tail its WAL. A respawned member comes back on a
+    FRESH port — clients discover it through the registry topology
+    watch (connect()'s `sync_replicas`), so the fixed-port constraint
+    is gone. Per-member WAL dirs live at
+    `wal_root/shard_<s>/replica_<r>`; a restarted member recovers from
+    its own snapshot + log and rejoins the group (bootstrapping over
+    the wire only when its log diverged or fell behind the primary's
+    retained base).
+    """
+
+    def __init__(
+        self,
+        data_dir: str,
+        num_shards: int,
+        registry_path: str,
+        wal_root: str,
+        replication: int = 2,
+        host: str = "127.0.0.1",
+        lease_ttl: float | None = None,
+        max_restarts: int = 8,
+        backoff_s: float = 0.25,
+        backoff_max_s: float = 5.0,
+        healthy_uptime_s: float = 30.0,
+        poll_s: float = 0.1,
+        native: bool = False,
+        env: dict | None = None,
+    ):
+        self.data_dir = data_dir
+        self.num_shards = int(num_shards)
+        self.registry_path = registry_path
+        self.wal_root = wal_root
+        self.replication = int(replication)
+        if self.replication < 1:
+            raise ValueError("replication must be >= 1")
+        self.host = host
+        self.lease_ttl = lease_ttl
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.healthy_uptime_s = float(healthy_uptime_s)
+        self.poll_s = float(poll_s)
+        self.native = native
+        self.env = dict(env) if env else None
+        os.makedirs(wal_root, exist_ok=True)
+        self.members = [
+            _Member(
+                s, r,
+                os.path.join(wal_root, f"shard_{s}", f"replica_{r}"),
+            )
+            for s in range(self.num_shards)
+            for r in range(self.replication)
+        ]
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._monitor: threading.Thread | None = None
+
+    def _registry(self):
+        from euler_tpu.distributed.rendezvous import make_registry
+
+        return make_registry(self.registry_path)
+
+    # -- process control -------------------------------------------------
+
+    def _spawn(self, m: _Member) -> None:
+        # callers hold self._lock (same discipline as ShardSupervisor)
+        os.makedirs(m.wal_dir, exist_ok=True)
+        # graftlint: disable=lock-unguarded-write -- every caller holds self._lock around _spawn
+        m.port = _free_port(self.host)
+        cmd = [
+            sys.executable, "-m", "euler_tpu.distributed.service",
+            "--data", self.data_dir,
+            "--shard", str(m.shard),
+            "--host", self.host,
+            "--port", str(m.port),
+            "--registry", self.registry_path,
+            "--wal-dir", m.wal_dir,
+            "--replica", str(m.rid),
+            "--replicas", str(self.replication),
+        ]
+        if self.lease_ttl is not None:
+            cmd += ["--lease-ttl", str(self.lease_ttl)]
+        if not self.native:
+            cmd.append("--no-native")
+        m.log_path = os.path.join(
+            self.wal_root, f"shard_{m.shard}_r{m.rid}.log"
+        )
+        env = dict(os.environ if self.env is None else self.env)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log = open(m.log_path, "ab")
+        try:
+            # graftlint: disable=lock-unguarded-write -- every caller holds self._lock around _spawn
+            m.proc = subprocess.Popen(
+                cmd, stdout=log, stderr=subprocess.STDOUT, env=env,
+                start_new_session=True,
+            )
+        finally:
+            log.close()
+        # graftlint: disable=lock-unguarded-write -- every caller holds self._lock around _spawn
+        m.started_at = time.monotonic()
+
+    def start(self) -> "ReplicaGroupSupervisor":
+        with self._lock:
+            for m in self.members:
+                self._spawn(m)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="replica-group-supervisor",
+        )
+        self._monitor.start()
+        return self
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            with self._lock:
+                for m in self.members:
+                    p = m.proc
+                    if m.failed or p is None:
+                        continue
+                    if p.poll() is None:
+                        if (
+                            m.window_restarts
+                            and now - m.started_at > self.healthy_uptime_s
+                        ):
+                            m.window_restarts = 0
+                        continue
+                    if m.next_spawn_at == 0.0:
+                        m.window_restarts += 1
+                        if m.window_restarts > self.max_restarts:
+                            m.failed = True
+                            print(
+                                f"# supervisor: shard {m.shard} replica"
+                                f" {m.rid} crash-looped past max_restarts"
+                                f"={self.max_restarts}; giving up on it"
+                                f" (exit {p.returncode})",
+                                file=sys.stderr, flush=True,
+                            )
+                            continue
+                        pause = min(
+                            self.backoff_s * 2 ** (m.window_restarts - 1),
+                            self.backoff_max_s,
+                        )
+                        m.next_spawn_at = now + pause
+                    elif now >= m.next_spawn_at:
+                        m.next_spawn_at = 0.0
+                        m.restarts += 1
+                        print(
+                            f"# supervisor: restarting shard {m.shard}"
+                            f" replica {m.rid} (exit {p.returncode},"
+                            f" restart #{m.restarts})",
+                            file=sys.stderr, flush=True,
+                        )
+                        self._spawn(m)
+            self._stop.wait(self.poll_s)
+
+    # -- operator surface ------------------------------------------------
+
+    def member(self, shard: int, rid: int) -> _Member:
+        for m in self.members:
+            if m.shard == shard and m.rid == rid:
+                return m
+        raise KeyError(f"no member shard={shard} replica={rid}")
+
+    def kill(self, shard: int, rid: int, sig: int = signal.SIGKILL) -> None:
+        """Send `sig` to one replica process (the chaos harness's
+        seeded `kill -9`)."""
+        with self._lock:
+            p = self.member(shard, rid).proc
+        if p is not None and p.poll() is None:
+            os.kill(p.pid, sig)
+
+    def primary_of(self, shard: int) -> int | None:
+        """Replica id of the shard's current lease holder, or None.
+        Matches the lease holder's `host:port` against live member
+        processes — the port changes across respawns, so this is read
+        fresh every call."""
+        lease = self._registry().observe(f"shard_{shard}")
+        if lease is None or lease["expires_in"] <= 0:
+            return None
+        holder = str(lease["holder"])
+        with self._lock:
+            for m in self.members:
+                if (
+                    m.shard == shard
+                    and f"{self.host}:{m.port}" == holder
+                    and m.proc is not None
+                    and m.proc.poll() is None
+                ):
+                    return m.rid
+        return None
+
+    def kill_primary(
+        self, shard: int, sig: int = signal.SIGKILL, timeout_s: float = 30.0
+    ) -> int:
+        """kill -9 the shard's CURRENT primary (whichever replica holds
+        the lease right now); returns the replica id killed."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            rid = self.primary_of(shard)
+            if rid is not None:
+                self.kill(shard, rid, sig)
+                return rid
+            time.sleep(0.1)
+        raise TimeoutError(f"shard {shard}: no live primary to kill")
+
+    def wait_healthy(self, timeout_s: float = 60.0) -> bool:
+        """Block until every shard group has ALL its replicas answering
+        ping AND a live lease (a primary elected). False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        reg = self._registry()
+        while time.monotonic() < deadline:
+            with self._lock:
+                ports = {
+                    (m.shard, m.rid): m.port
+                    for m in self.members
+                    if m.proc is not None and m.proc.poll() is None
+                }
+            ok = len(ports) == len(self.members) and all(
+                _ping(self.host, port) == shard
+                for (shard, _r), port in ports.items()
+            )
+            if ok:
+                for s in range(self.num_shards):
+                    lease = reg.observe(f"shard_{s}")
+                    if lease is None or lease["expires_in"] <= 0:
+                        ok = False
+                        break
+            if ok:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "members": {
+                    f"{m.shard}/{m.rid}": {
+                        "port": m.port,
+                        "alive": bool(
+                            m.proc is not None and m.proc.poll() is None
+                        ),
+                        "restarts": m.restarts,
+                        "failed": m.failed,
+                        "pid": getattr(m.proc, "pid", None),
+                    }
+                    for m in self.members
+                },
+            }
+
+    def stop(self, term_timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        with self._lock:
+            procs = [m.proc for m in self.members if m.proc is not None]
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + term_timeout_s
+        for p in procs:
+            remaining = max(deadline - time.monotonic(), 0.1)
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                    p.wait(timeout=5.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+
+
 class TrainerSupervisor:
     """Supervise ONE durable trainer process (`tools/train.py`).
 
@@ -484,15 +782,30 @@ def main(argv=None) -> int:
                     help="comma-separated fixed ports (default: auto)")
     ap.add_argument("--max-restarts", type=int, default=8)
     ap.add_argument("--native", action="store_true")
+    ap.add_argument("--replication", type=int, default=1,
+                    help="replicas per shard; >1 supervises lease-"
+                         "coordinated replica groups on dynamic ports")
+    ap.add_argument("--lease-ttl", type=float, default=None)
     args = ap.parse_args(argv)
     ports = (
         [int(p) for p in args.ports.split(",")] if args.ports else None
     )
-    sup = ShardSupervisor(
-        args.data, args.shards, args.registry, args.wal_root,
-        host=args.host, ports=ports, max_restarts=args.max_restarts,
-        native=args.native,
-    ).start()
+    if args.replication > 1:
+        if ports is not None:
+            raise SystemExit("--ports is incompatible with --replication"
+                             " (replica groups respawn on fresh ports)")
+        sup = ReplicaGroupSupervisor(
+            args.data, args.shards, args.registry, args.wal_root,
+            replication=args.replication, host=args.host,
+            lease_ttl=args.lease_ttl, max_restarts=args.max_restarts,
+            native=args.native,
+        ).start()
+    else:
+        sup = ShardSupervisor(
+            args.data, args.shards, args.registry, args.wal_root,
+            host=args.host, ports=ports, max_restarts=args.max_restarts,
+            native=args.native,
+        ).start()
     healthy = sup.wait_healthy(timeout_s=120.0)
     print(json.dumps({"healthy": healthy, **sup.stats()}), flush=True)
     done = threading.Event()

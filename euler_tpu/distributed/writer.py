@@ -42,9 +42,11 @@ import itertools
 import json
 import os
 import threading
+import time
 
 import numpy as np
 
+from euler_tpu.distributed.errors import NotPrimaryError, RpcError
 from euler_tpu.graph.meta import GraphMeta
 
 
@@ -69,9 +71,14 @@ class GraphWriter:
         "delete_edges",
         "get_meta",
         "publish_epoch",
+        "repl_status",
         "upsert_edges",
         "upsert_nodes",
     })
+
+    # NotPrimaryError redirects followed per batch before giving up —
+    # bounds the wait for an in-flight election (lease TTLs are seconds)
+    REDIRECT_CAP = 8
 
     def __init__(self, graph, batch_rows: int = 4096, writer_id: str | None = None):
         self.graph = graph
@@ -94,10 +101,16 @@ class GraphWriter:
         self._outbox: list = []  # (shard_idx, verb, values)
         self._local_deltas: dict = {}
         self._closed = False
+        # replica groups: per-shard primary hint (host, port) — learned
+        # from NotPrimaryError redirects / repl_status discovery and
+        # passed as call(prefer=) so mutations pin the primary while
+        # reads keep round-robining the whole replica set
+        self._primaries: dict[int, tuple[str, int]] = {}
         # telemetry (GIL-racy increments fine — repo counter stance)
         self.batches_sent = 0
         self.rows_sent = 0
         self.publishes = 0
+        self.redirects = 0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -305,16 +318,13 @@ class GraphWriter:
             key, shard_idx, verb, values = entry
             sh = self.graph.shards[shard_idx]
             if hasattr(sh, "call"):
-                # literal verbs: the wire-protocol checker diffs these
-                # sends against the declared tables
-                if verb == "upsert_nodes":
-                    reply = sh.call("upsert_nodes", [key] + values)
-                elif verb == "upsert_edges":
-                    reply = sh.call("upsert_edges", [key] + values)
-                elif verb == "delete_edges":
-                    reply = sh.call("delete_edges", [key] + values)
-                else:  # guarded in delete_nodes()
+                if verb not in (
+                    "upsert_nodes", "upsert_edges", "delete_edges"
+                ):  # guarded in delete_nodes()
                     raise ValueError("delete_nodes is not a wire verb")
+                reply = self._send_mutation(
+                    sh, shard_idx, verb, [key] + values
+                )
                 self.rows_sent += int(reply[0])
             else:
                 d = self._local_delta(shard_idx)
@@ -331,6 +341,83 @@ class GraphWriter:
             self.batches_sent += 1
             sent += 1
         return sent
+
+    # -- replica-group routing --------------------------------------------
+
+    def set_primary(self, shard_idx: int, addr: tuple[str, int]) -> None:
+        """Pin shard `shard_idx`'s mutations to one replica address —
+        normally learned automatically (NotPrimaryError redirects and
+        repl_status discovery); exposed for operators and tests."""
+        self._primaries[int(shard_idx)] = (str(addr[0]), int(addr[1]))
+
+    def discover_primaries(self) -> dict[int, tuple[str, int]]:
+        """Eagerly discover and pin every shard's primary (repl_status
+        against any replica) — the first batch then lands on the lease
+        holder instead of paying a NotPrimaryError redirect. Solo
+        shards and shards mid-election are simply left unpinned."""
+        for idx, sh in enumerate(self.graph.shards):
+            if hasattr(sh, "call"):
+                addr = self._discover_primary(sh)
+                if addr is not None:
+                    self.set_primary(idx, addr)
+        return dict(self._primaries)
+
+    def _discover_primary(self, sh) -> tuple[str, int] | None:
+        """Ask any replica of this shard who the primary is. Returns
+        None for solo shards, during an election, or on failure."""
+        try:
+            st = json.loads(sh.call("repl_status", [])[0])
+        except (RpcError, OSError, ConnectionError):
+            return None
+        addr = st.get("primary")
+        if not addr or ":" not in str(addr):
+            return None
+        host, _, port = str(addr).rpartition(":")
+        try:
+            return host, int(port)
+        except ValueError:
+            return None
+
+    def _send_mutation(self, sh, shard_idx: int, verb: str, payload: list):
+        """One mutation RPC with replica-group routing: pin the known
+        primary when there is one, and on the typed NotPrimaryError
+        re-route to the address the rejection names (re-discovering via
+        repl_status while an election is in flight). The payload keeps
+        its original idempotency key across every redirect, so the
+        retry is exactly-once even when the first attempt's ack was
+        lost to the failover."""
+        last: Exception | None = None
+        for attempt in range(self.REDIRECT_CAP):
+            prefer = self._primaries.get(shard_idx)
+            kw = {"prefer": prefer} if prefer is not None else {}
+            try:
+                # literal verbs: the wire-protocol checker diffs these
+                # sends against the declared tables
+                if verb == "upsert_nodes":
+                    return sh.call("upsert_nodes", payload, **kw)
+                if verb == "upsert_edges":
+                    return sh.call("upsert_edges", payload, **kw)
+                if verb == "delete_edges":
+                    return sh.call("delete_edges", payload, **kw)
+                if verb == "publish_epoch":
+                    return sh.call("publish_epoch", payload, **kw)
+                raise ValueError(f"not a mutation verb: {verb!r}")
+            except NotPrimaryError as e:
+                last = e
+                self.redirects += 1
+                addr = NotPrimaryError.parse_primary(str(e))
+                if addr is not None and addr != prefer:
+                    self._primaries[shard_idx] = addr
+                    continue
+                # primary=? (election in flight) or a hint the group
+                # just rejected: drop it, give the election a beat,
+                # then ask the group directly
+                self._primaries.pop(shard_idx, None)
+                time.sleep(min(0.1 * (attempt + 1), 0.5))
+                addr = self._discover_primary(sh)
+                if addr is not None:
+                    self._primaries[shard_idx] = addr
+        raise last
 
     # -- publish ----------------------------------------------------------
 
@@ -351,7 +438,9 @@ class GraphWriter:
         exact = True
         for s, sh in enumerate(self.graph.shards):
             if hasattr(sh, "call"):
-                ep, rows, ids, n = sh.call("publish_epoch", [self._key()])[:4]
+                ep, rows, ids, n = self._send_mutation(
+                    sh, s, "publish_epoch", [self._key()]
+                )[:4]
                 sh.on_publish(ep, rows=rows, ids=ids, num_nodes=int(n))
             else:
                 delta = self._local_deltas.pop(s, None)

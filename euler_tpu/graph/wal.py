@@ -78,6 +78,26 @@ WAL_VERBS = frozenset({
 SNAP_PREFIX = "snap_"
 WAL_FILE = "wal.log"
 
+# Term stamping (PR 13 replication) rides the op string the way deadline
+# budgets ride the wire op (wire.DEADLINE_PREFIX): a record written by a
+# primary at term T stores op "@t:<T>:<op>". The frame layout is
+# untouched, and a pre-replication WAL — whose ops carry no envelope —
+# unwraps to term 0, so old logs replay unchanged.
+TERM_PREFIX = "@t:"
+
+
+def wrap_term(op: str, term: int) -> str:
+    """Envelope `op` with the primary's lease term (0 = no envelope)."""
+    return f"{TERM_PREFIX}{int(term)}:{op}" if term > 0 else op
+
+
+def unwrap_term(op: str) -> tuple[str, int]:
+    """(inner op, term) — (op, 0) for pre-replication records."""
+    if not op.startswith(TERM_PREFIX):
+        return op, 0
+    _, term, inner = op.split(":", 2)
+    return inner, int(term)
+
 
 def fsync_mode() -> str:
     """EULER_TPU_WAL_FSYNC: "batch" (default — group commit across
@@ -98,11 +118,12 @@ def snapshot_every() -> int:
     return int(os.environ.get("EULER_TPU_SNAPSHOT_EVERY", 4))
 
 
-def encode_record(op: str, values: list) -> bytes:
-    """One WAL record for (op, values), wire payload encoding inside."""
-    if op not in WAL_VERBS:
+def encode_record(op: str, values: list, term: int = 0) -> bytes:
+    """One WAL record for (op, values), wire payload encoding inside;
+    `term > 0` stamps the writing primary's lease term into the op."""
+    if unwrap_term(op)[0] not in WAL_VERBS:
         raise ValueError(f"op {op!r} is not a WAL record type (WAL_VERBS)")
-    frame = wire.encode(op, values)
+    frame = wire.encode(wrap_term(op, term), values)
     payload = bytes(memoryview(frame)[4:])  # drop the frame length prefix
     return _REC.pack(len(payload), zlib.crc32(payload)) + payload
 
@@ -148,12 +169,12 @@ class WriteAheadLog:
 
     # -- append path -----------------------------------------------------
 
-    def write(self, op: str, values: list) -> tuple[int, int]:
+    def write(self, op: str, values: list, term: int = 0) -> tuple[int, int]:
         """Buffered append; returns (seq, end_logical_offset). NOT yet
         durable — call commit(seq) before acking. Callers that need the
         record order to match another structure's mutation order (the
         service's delta staging) hold their ordering lock around this."""
-        rec = encode_record(op, values)
+        rec = encode_record(op, values, term)
         with self._lock:
             self._f.write(rec)
             self._f.flush()  # to the OS — fsync is commit()'s job
@@ -177,9 +198,9 @@ class WriteAheadLog:
             os.fsync(fd)
             self._synced_seq = target
 
-    def append(self, op: str, values: list) -> int:
+    def append(self, op: str, values: list, term: int = 0) -> int:
         """write + commit; returns the end logical offset."""
-        seq, pos = self.write(op, values)
+        seq, pos = self.write(op, values, term)
         self.commit(seq)
         return pos
 
@@ -195,6 +216,100 @@ class WriteAheadLog:
         durability-lag stat)."""
         with self._lock:
             return self._size
+
+    # -- shipping (PR 13 replication) ------------------------------------
+
+    def read_raw(self, from_logical: int, max_bytes: int) -> tuple[bytes, int]:
+        """Raw record bytes for the log suffix starting at `from_logical`
+        (a logical offset), cut at a record boundary ≤ `max_bytes` (the
+        first record always ships whole so progress is guaranteed).
+        Returns (bytes, end_logical). Serves only what `write()` already
+        flushed — a concurrent half-buffered record is invisible because
+        writes land under the lock and flush before releasing it.
+        Raises ValueError when `from_logical` predates the base (that
+        prefix was trimmed into a snapshot — ship the snapshot instead)."""
+        with self._lock:
+            if from_logical < self.base:
+                raise ValueError(
+                    f"logical {from_logical} < base {self.base} (trimmed)"
+                )
+            end = self.base + self._size
+            if from_logical >= end:
+                return b"", end
+            self._f.flush()
+            with open(self.path, "rb") as f:
+                f.seek(_HEADER.size + (from_logical - self.base))
+                blob = f.read(end - from_logical)
+        # cut at the last whole-record boundary inside max_bytes
+        off = 0
+        while off + _REC.size <= len(blob):
+            (n, _crc) = _REC.unpack_from(blob, off)
+            rec_end = off + _REC.size + n
+            if rec_end > len(blob):
+                break  # only whole records ship
+            if off > 0 and rec_end > max_bytes:
+                break
+            off = rec_end
+        return bytes(blob[:off]), from_logical + off
+
+    def crc_range(self, from_logical: int, to_logical: int) -> int:
+        """crc32 of the raw bytes in [from_logical, to_logical) — the
+        log-continuity handshake: a follower offers the checksum of its
+        own tail and the primary compares against the same logical range
+        of ITS log. A mismatch means the histories diverged (an
+        ex-primary carrying un-replicated records), so the follower must
+        rebootstrap from a snapshot instead of appending a suffix onto a
+        different prefix. Raises ValueError when the range is outside
+        this log (trimmed below, or beyond the end)."""
+        with self._lock:
+            if (
+                from_logical < self.base
+                or to_logical > self.base + self._size
+                or from_logical > to_logical
+            ):
+                raise ValueError(
+                    f"crc range [{from_logical}, {to_logical}) outside"
+                    f" log [{self.base}, {self.base + self._size})"
+                )
+            self._f.flush()
+            with open(self.path, "rb") as f:
+                f.seek(_HEADER.size + (from_logical - self.base))
+                blob = f.read(to_logical - from_logical)
+        return zlib.crc32(blob) & 0xFFFFFFFF
+
+    def append_raw(self, data: bytes) -> int:
+        """Append already-encoded records verbatim (a follower applying a
+        shipped suffix — the caller validated record integrity by parsing
+        first) and fsync them per the fsync mode. Byte-identical appends
+        keep every replica's logical offsets interchangeable. Returns the
+        new end logical offset."""
+        if not data:
+            return self.tell()
+        with self._lock:
+            self._f.write(data)
+            self._f.flush()
+            self._size += len(data)
+            self._written_seq += 1
+            self.records_written += 1
+            seq, end = self._written_seq, self.base + self._size
+        self.commit(seq)
+        return end
+
+    def reset(self, base_logical: int) -> None:
+        """Drop every record and restart the log at `base_logical` — a
+        follower installing a shipped snapshot starts its (byte-
+        interchangeable) log at the snapshot's covered position."""
+        with self._sync_lock, self._lock:
+            self._f.close()
+            tmp = self.path + ".reset"
+            with open(tmp, "wb") as f:
+                f.write(_HEADER.pack(MAGIC, int(base_logical)))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "ab")
+            self.base = int(base_logical)
+            self._size = 0
 
     # -- trim ------------------------------------------------------------
 
@@ -235,12 +350,49 @@ class WriteAheadLog:
             self._f.close()
 
 
-def scan(path: str) -> tuple[list[tuple[str, list, int]], int, int]:
-    """Parse a WAL file. Returns (records, base, valid_end_logical);
-    each record is (op, values, end_logical_offset).
+def parse_records(
+    blob, start_logical: int
+) -> tuple[list[tuple[str, list, int, int]], int]:
+    """Parse raw record bytes (no file header) starting at logical
+    offset `start_logical`. Returns (records, valid_end_logical); each
+    record is (op, values, end_logical_offset, term) with the term
+    envelope unwrapped (pre-replication records → term 0).
 
     Stops at the first torn or corrupt record (short header, short
-    payload, CRC mismatch, undecodable payload): everything before it is
+    payload, CRC mismatch, undecodable payload, non-WAL op): everything
+    before it is the valid prefix. Shared by `scan` (file replay) and
+    the replication follower (validating a shipped suffix before the
+    verbatim `append_raw`)."""
+    records: list[tuple[str, list, int, int]] = []
+    off = 0
+    valid = 0
+    while off + _REC.size <= len(blob):
+        n, crc = _REC.unpack_from(blob, off)
+        start = off + _REC.size
+        if start + n > len(blob):
+            break  # torn tail: length prefix written, payload cut short
+        payload = blob[start : start + n]
+        if zlib.crc32(payload) != crc:
+            break  # corrupt (or a torn length field pointing at garbage)
+        try:
+            op, values = decode_record(payload)
+            op, term = unwrap_term(op)
+        except ValueError:
+            break  # CRC collision on garbage — still a broken tail
+        if op not in WAL_VERBS:
+            break
+        off = start + n
+        valid = off
+        records.append((op, values, int(start_logical) + off, term))
+    return records, int(start_logical) + valid
+
+
+def scan(path: str) -> tuple[list[tuple[str, list, int]], int, int]:
+    """Parse a WAL file. Returns (records, base, valid_end_logical);
+    each record is (op, values, end_logical_offset) — terms, if any,
+    already unwrapped (`parse_records` exposes them when needed).
+
+    Stops at the first torn or corrupt record: everything before it is
     the valid prefix, everything from it on is dropped by
     `truncate_torn_tail`. A missing file is an empty log."""
     if not os.path.exists(path):
@@ -252,27 +404,12 @@ def scan(path: str) -> tuple[list[tuple[str, list, int]], int, int]:
     magic, base = _HEADER.unpack_from(blob, 0)
     if magic != MAGIC:
         raise ValueError(f"{path}: not a WAL file (bad magic)")
-    records: list[tuple[str, list, int]] = []
-    off = _HEADER.size
-    valid = off
-    while off + _REC.size <= len(blob):
-        n, crc = _REC.unpack_from(blob, off)
-        start = off + _REC.size
-        if start + n > len(blob):
-            break  # torn tail: length prefix written, payload cut short
-        payload = blob[start : start + n]
-        if zlib.crc32(payload) != crc:
-            break  # corrupt (or a torn length field pointing at garbage)
-        try:
-            op, values = decode_record(payload)
-        except ValueError:
-            break  # CRC collision on garbage — still a broken tail
-        if op not in WAL_VERBS:
-            break
-        off = start + n
-        valid = off
-        records.append((op, values, int(base) + off - _HEADER.size))
-    return records, int(base), int(base) + valid - _HEADER.size
+    records4, valid_end = parse_records(blob[_HEADER.size:], int(base))
+    return (
+        [(op, values, end) for op, values, end, _term in records4],
+        int(base),
+        valid_end,
+    )
 
 
 def truncate_torn_tail(path: str) -> int:

@@ -143,14 +143,18 @@ def watch_reload(servers, model_dir: str, stop_event, poll_s: float):
                 )
 
 
-def _durability_probe(graph_json: dict, watch_ids) -> dict:
+def _durability_probe(graph_json: dict, watch_ids, replication: int = 1) -> dict:
     """Boot one DURABLE graph shard (WAL + snapshots) in a temp dir,
     stream a couple of mutations through the wire, and report the
     operator-facing durability stats — the selftest's proof that
     `wal_bytes` / `last_snapshot_epoch` / `recovering` surface end to
-    end, and what a fleet's `graph_shards` section will carry."""
+    end, and what a fleet's `graph_shards` section will carry. With
+    `replication > 1` the shard is a lease-coordinated replica group
+    instead: R members, quorum-acked writes, and the probe additionally
+    proves every follower converged bit-identical to the primary."""
     import shutil
     import tempfile
+    import time as _time
 
     import numpy as np
 
@@ -160,14 +164,29 @@ def _durability_probe(graph_json: dict, watch_ids) -> dict:
     from euler_tpu.graph.builder import convert_json
 
     tmp = tempfile.mkdtemp(prefix="etpu_serve_durability_")
-    svc = None
+    svcs = []
     try:
         data_dir = f"{tmp}/graph"
         convert_json(graph_json, data_dir, num_partitions=1)
-        svc = serve_shard(
-            data_dir, 0, native=False, wal_dir=f"{tmp}/wal",
-        )
-        graph = connect(cluster={0: [(svc.host, svc.port)]})
+        if replication > 1:
+            for r in range(replication):
+                svcs.append(serve_shard(
+                    data_dir, 0, native=False,
+                    registry_path=f"{tmp}/reg",
+                    wal_dir=f"{tmp}/wal_r{r}",
+                    replica=r, group_size=replication, lease_ttl=2.0,
+                ))
+            deadline = _time.monotonic() + 15.0
+            while _time.monotonic() < deadline and not any(
+                s.repl_status()["role"] == "primary" for s in svcs
+            ):
+                _time.sleep(0.05)
+            graph = connect(registry_path=f"{tmp}/reg", num_shards=1)
+        else:
+            svcs.append(serve_shard(
+                data_dir, 0, native=False, wal_dir=f"{tmp}/wal",
+            ))
+            graph = connect(cluster={0: [(svcs[0].host, svcs[0].port)]})
         with GraphWriter(graph) as w:
             w.upsert_edges(
                 np.asarray(watch_ids, np.uint64),
@@ -178,24 +197,59 @@ def _durability_probe(graph_json: dict, watch_ids) -> dict:
             w.flush()
             pre = graph.shards[0].stats()
             w.publish()
-        svc.snapshot_now()
+        primary = next(
+            (s for s in svcs if s.repl_status()["role"] == "primary"),
+            svcs[0],
+        )
+        primary.snapshot_now()
         post = graph.shards[0].stats()
-        return {
+        out = {
             "wal_bytes": int(pre.get("wal_bytes", 0)),
             "wal_bytes_after_snapshot": int(post.get("wal_bytes", 0)),
             "last_snapshot_epoch": post.get("last_snapshot_epoch"),
             "recovering": post.get("recovering"),
             "graph_epoch": post.get("graph_epoch"),
         }
+        if replication > 1:
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline and any(
+                s._wal.tell() != primary._wal.tell() for s in svcs
+            ):
+                _time.sleep(0.05)
+            ref = primary.store.arrays
+            parity = all(
+                sorted(s.store.arrays) == sorted(ref)
+                and all(
+                    np.array_equal(
+                        np.asarray(s.store.arrays[k]), np.asarray(ref[k])
+                    )
+                    for k in ref
+                )
+                for s in svcs
+            )
+            st = primary.repl_status()
+            out["replication"] = {
+                "group_size": replication,
+                "term": st["term"],
+                "ack_mode": st["ack_mode"],
+                "bit_parity": bool(parity),
+            }
+        if hasattr(graph, "stop_topology_watch"):
+            graph.stop_topology_watch()
+        return out
     except Exception as e:  # surfaced in the JSON, fails the selftest
         return {"error": repr(e)[:200]}
     finally:
-        if svc is not None:
+        for svc in svcs:
             svc.stop()
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def selftest(replicas: int = 1, hedge_ms: float | None = None) -> int:
+def selftest(
+    replicas: int = 1,
+    hedge_ms: float | None = None,
+    replication: int = 1,
+) -> int:
     """In-process boot: synthetic graph → 2-step checkpoint → fleet +
     concurrent clients → bit-parity vs direct inference. Exit 0 = the
     serving path works end to end on this host. replicas > 1 also proves
@@ -319,10 +373,15 @@ def selftest(replicas: int = 1, hedge_ms: float | None = None) -> int:
     for s in servers:
         s.stop()
     durability = _durability_probe(
-        {"nodes": nodes, "edges": edges}, all_ids[:4]
+        {"nodes": nodes, "edges": edges}, all_ids[:4],
+        replication=replication,
     )
     ok = ok and durability.get("wal_bytes", 0) > 0
     ok = ok and durability.get("recovering") is False
+    if replication > 1:
+        ok = ok and (
+            durability.get("replication", {}).get("bit_parity") is True
+        )
     out = {
         "selftest": "ok" if ok else "MISMATCH",
         "durability": durability,
@@ -367,6 +426,10 @@ def main(argv=None) -> int:
                     help="shard index of the FIRST replica (registry key)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="number of ModelServer replicas to boot")
+    ap.add_argument("--replication", type=int, default=1, metavar="R",
+                    help="graph-shard replica-group size for the "
+                         "selftest durability probe (R>1 proves "
+                         "quorum-acked writes + follower bit-parity)")
     ap.add_argument("--hedge", type=float, default=None, metavar="MS",
                     help="recommended client hedge delay for this fleet "
                          "(ms; default p95-tracked, EULER_TPU_HEDGE_MS)")
@@ -379,7 +442,11 @@ def main(argv=None) -> int:
     if args.replicas < 1:
         ap.error("--replicas must be >= 1")
     if args.selftest:
-        return selftest(replicas=args.replicas, hedge_ms=args.hedge)
+        return selftest(
+            replicas=args.replicas,
+            hedge_ms=args.hedge,
+            replication=args.replication,
+        )
     if not args.data or not args.model_dir:
         ap.error("--data and --model-dir are required (or --selftest)")
     servers = serve_fleet(args)

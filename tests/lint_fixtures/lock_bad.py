@@ -69,6 +69,29 @@ class QuarantineRace:
         replica.bad_until = 5.0
 
 
+class TopologySyncRace:
+    """The pre-PR-13 replica-list form: the picker snapshots a shard
+    entry's replica list under the pool lock, but the topology-refresh
+    thread rebinds it lock-free — the locked scan can interleave with a
+    half-applied membership swap."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = []
+
+    def pick(self, entry):
+        with self._lock:
+            for r in entry.members:
+                if r.ok:
+                    return r
+            return None
+
+    def on_refresh(self, entry, addrs):
+        # lock-unguarded-write: pick() iterates entry.members under
+        # self._lock
+        entry.members = list(addrs)
+
+
 class LazyOnConcurrentClass:
     """A class that owns a lock declares itself concurrent — unlocked
     lazy init of shared state is check-then-act."""

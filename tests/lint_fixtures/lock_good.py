@@ -67,6 +67,28 @@ class QuarantineFixed:
             replica.bad_until = 5.0
 
 
+class TopologySyncFixed:
+    """The PR-13 form: membership swaps happen under the same lock the
+    picker scans under — one reference assignment (copy-on-write), so
+    the locked reader sees the old list or the new one, never a torn
+    mix."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = []
+
+    def pick(self, entry):
+        with self._lock:
+            for r in entry.members:
+                if r.ok:
+                    return r
+            return None
+
+    def on_refresh(self, entry, addrs):
+        with self._lock:
+            entry.members = tuple(addrs)
+
+
 def thread_confined():
     # attributes of threading.local() are per-thread — lazy init is fine
     if getattr(_TLS, "buf", None) is None:

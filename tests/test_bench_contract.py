@@ -102,6 +102,21 @@ def test_bench_smoke_emits_final_json_line():
     assert row["durability_fsync_overhead_x"] >= 0.8, row
     assert row["durability_snapshot_ms"] > 0
     assert row["durability_recovery_ms"] > 0
+    # the availability lane (ISSUE 13) must not silently vanish: acked
+    # rows/s under quorum vs async vs solo acks, the lease-bounded
+    # write-unavailability window across a primary kill, follower
+    # catch-up MB/s over wal_ship, and the caught-up follower ==
+    # primary bit-parity oracle all ride the artifact
+    assert row["availability"] is True, row
+    assert row["availability_bit_parity"] is True, row
+    assert row["availability_unavail_window_ms"] > 0
+    assert row["availability_quorum_rows_per_sec"] > 0
+    assert row["availability_async_rows_per_sec"] > 0
+    assert row["availability_solo_rows_per_sec"] > 0
+    # a quorum ack adds a follower round trip; it can only cost
+    # throughput relative to solo, never add it (allow noise)
+    assert row["availability_quorum_overhead_x"] >= 0.8, row
+    assert row["availability_catchup_mb_per_sec"] > 0
     # the durable-training resume lane (ISSUE 10) must not silently
     # vanish: the sync-vs-async save stall A/B (the cadence/step-time
     # tradeoff), resume-to-first-step latency, retained-checkpoint disk

@@ -113,6 +113,10 @@ def test_gate_covers_the_package():
         "euler_tpu/analytics/algorithms.py",
         "euler_tpu/analytics/sweeps.py",
         "euler_tpu/tools/analytics.py",
+        # the replication lane (ISSUE 13): lease fencing, quorum-ack
+        # condition variables, and the WAL-shipping tail loop — lock-
+        # discipline and wire-protocol territory
+        "euler_tpu/distributed/replication.py",
         "bench.py",
     ):
         assert must in rels, f"{must} escaped the lint gate"
@@ -149,13 +153,15 @@ def test_lock_discipline_fixture_trips():
     assert ids["lock-mixed-write"] == 2, findings
     # the PR-4 regression: quarantine timestamps read under the pool lock
     # in the picker, written lock-free in the failure path — graftlint
-    # must catch the old RemoteShard.bad_until form
-    assert ids["lock-unguarded-write"] == 1, findings
-    unguarded = next(
-        f for f in findings if f.check == "lock-unguarded-write"
-    )
-    assert "bad_until" in unguarded.message
-    assert unguarded.symbol == "QuarantineRace.on_failure"
+    # must catch the old RemoteShard.bad_until form; plus the PR-13
+    # regression: replica lists rebound lock-free by the topology
+    # refresh while the picker iterates them under the lock
+    assert ids["lock-unguarded-write"] == 2, findings
+    unguarded = {
+        f.symbol: f for f in findings if f.check == "lock-unguarded-write"
+    }
+    assert "bad_until" in unguarded["QuarantineRace.on_failure"].message
+    assert "members" in unguarded["TopologySyncRace.on_refresh"].message
     # the regression the ISSUE pins: the pre-PR-2 _jit_cache
     # attribute-injection get-or-build race must be among them
     racy = [f for f in findings if f.check == "lock-racy-init"]
@@ -300,10 +306,62 @@ def test_wal_lockstep_fixed_form_clean():
     # the real repo's tables are in lockstep at HEAD (also covered by the
     # gate, but assert it here with the runtime objects so a drift names
     # this test, not a generic lint failure)
+    from euler_tpu.distributed import replication
     from euler_tpu.distributed.writer import GraphWriter
     from euler_tpu.graph.wal import WAL_VERBS
 
-    assert WAL_VERBS == GraphWriter.WIRE_VERBS - {"get_meta"}
+    assert WAL_VERBS == (
+        GraphWriter.WIRE_VERBS - {"get_meta"} - replication.WIRE_VERBS
+    )
+
+
+def test_wal_lockstep_replication_verbs_exempt():
+    """The writer speaks repl_status (primary discovery) — a replication-
+    control verb, not a mutation. With the replication module's
+    WIRE_VERBS table in the project the lockstep check exempts it; with
+    the module absent (older slices, fixtures) the same writer table
+    trips as an un-WAL'd mutation — the drift pair that keeps the
+    exemption itself honest."""
+    from euler_tpu.analysis.checkers.wire_protocol import (
+        REPL_TABLE,
+        WAL_CLIENT,
+        WAL_TABLE,
+        check_wal_lockstep,
+    )
+
+    writer_src = (
+        "class W:\n"
+        "    WIRE_VERBS = frozenset({\n"
+        "        'get_meta', 'upsert_nodes', 'upsert_edges',\n"
+        "        'delete_edges', 'publish_epoch', 'repl_status',\n"
+        "    })\n"
+    )
+    wal_src = (
+        "WAL_VERBS = frozenset({'upsert_nodes', 'upsert_edges',"
+        " 'delete_edges', 'publish_epoch'})\n"
+    )
+    repl_src = (
+        "WIRE_VERBS = frozenset({'repl_status', 'wal_pos', 'wal_ship'})\n"
+    )
+    with_repl = Project(
+        [
+            Module(WAL_TABLE[0], WAL_TABLE[0], wal_src),
+            Module(WAL_CLIENT, WAL_CLIENT, writer_src),
+            Module(REPL_TABLE[0], REPL_TABLE[0], repl_src),
+        ],
+        root=".",
+    )
+    assert check_wal_lockstep(with_repl) == []
+    without_repl = Project(
+        [
+            Module(WAL_TABLE[0], WAL_TABLE[0], wal_src),
+            Module(WAL_CLIENT, WAL_CLIENT, writer_src),
+        ],
+        root=".",
+    )
+    drift = check_wal_lockstep(without_repl)
+    assert len(drift) == 1 and drift[0].check == "wire-wal-drift"
+    assert "repl_status" in drift[0].message
 
 
 # ---------------------------------------------------------------------------

@@ -11,6 +11,9 @@ the crash applied once, and no typed error ever leaked to a reader.
 import json
 import os
 import signal
+import socket
+import subprocess
+import sys
 import threading
 import time
 
@@ -18,6 +21,7 @@ import numpy as np
 import pytest
 
 from euler_tpu.distributed import connect
+from euler_tpu.distributed.rendezvous import TcpRegistry
 from euler_tpu.distributed.supervisor import ShardSupervisor, _ping
 from euler_tpu.distributed.writer import GraphWriter
 from euler_tpu.graph import Graph
@@ -351,6 +355,92 @@ def test_scenario_kill9_recovery_under_live_traffic(cluster, tmp_path):
         # epoch restored to what the live cluster last published (a
         # shard whose final wave staged nothing keeps its older epoch)
         assert stores[p].graph_epoch == final_epochs[p]
+
+
+def test_scenario_rendezvous_kill9_reregistration(tmp_path, monkeypatch):
+    """Registry-death chaos (ISSUE 13 satellite): the TcpRegistry server
+    is kill -9'd mid-run. Already-connected clients ride the outage on
+    their cached topology (empty registry reads keep the current replica
+    set), every server's heartbeat loop keeps beating through the gap,
+    writes keep landing (shard ports don't depend on the registry), and
+    when a supervised restart brings the rendezvous back on its FIXED
+    port the whole membership table re-populates by itself — no typed
+    error ever leaking to a reader."""
+    monkeypatch.setenv("EULER_TPU_RPC_RETRY_BUDGET", "10000")
+    base = _graph_dict()
+    d = str(tmp_path / "graph")
+    convert_json(base, d, num_partitions=2)
+    # fixed port: pick a free one, then serve the rendezvous from a child
+    # process on it so kill -9 + respawn lands on the same address
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def spawn_rdv():
+        return subprocess.Popen(
+            [sys.executable, "-m", "euler_tpu.distributed.rendezvous",
+             "--port", str(port), "--ttl", "10.0"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+
+    rdv = spawn_rdv()
+    spec = f"tcp://127.0.0.1:{port}"
+    reg = TcpRegistry(f"127.0.0.1:{port}")
+    sup = ShardSupervisor(
+        d, 2, spec, str(tmp_path / "wal"),
+        backoff_s=0.2, healthy_uptime_s=5.0,
+    ).start()
+    g = None
+    try:
+        assert sup.wait_healthy(60), sup.stats()
+        reg.wait_for(2, 30)
+        g = connect(registry_path=spec, num_shards=2)
+        stop = threading.Event()
+        leaks: list = []
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    g.get_dense_feature(np.asarray([2, 3], np.uint64),
+                                        ["feat"])
+            except Exception as e:  # noqa: BLE001
+                leaks.append(f"reader: {e!r}")
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+
+        os.kill(rdv.pid, signal.SIGKILL)
+        rdv.wait()
+        # the registry is REALLY gone: lookups degrade to "membership
+        # unknown" (empty) instead of raising into readers
+        assert reg.lookup(2) == {0: [], 1: []}
+        time.sleep(1.0)  # let the reader + heartbeat loops ride the gap
+        w = GraphWriter(g)
+        w.upsert_edges([1, 2], [5, 6], [0, 0], [3.0, 4.0])
+        res = w.publish()
+        assert res["epochs"] == {0: 1, 1: 1}, res["epochs"]
+        w.close()
+
+        # supervised restart on the same fixed port: the in-memory table
+        # was lost, yet every shard's beat loop re-registers on its own
+        rdv = spawn_rdv()
+        table = reg.wait_for(2, 30)
+        assert all(table[s] for s in range(2)), table
+        # a FRESH client can bootstrap from the reborn registry
+        g2 = connect(registry_path=spec, num_shards=2, watch=False)
+        assert len(g2.get_dense_feature(
+            np.asarray([2], np.uint64), ["feat"])) == 1
+
+        stop.set()
+        t.join(timeout=30)
+        assert not leaks, leaks[:5]
+    finally:
+        if g is not None:
+            g.stop_topology_watch()
+        sup.stop()
+        if rdv.poll() is None:
+            rdv.kill()
 
 
 def test_ping_helper_roundtrip(cluster):
