@@ -1084,6 +1084,175 @@ def _analytics_lane(smoke: bool) -> dict:
     }
 
 
+def _dr_lane(smoke: bool) -> dict:
+    """Disaster-recovery lane (ISSUE 15; EULER_BENCH_DR=0 opt-out):
+    epoch-consistent backup throughput, total-loss restore-to-first-read
+    latency, at-rest scrub throughput and its interference with a live
+    reader, and the `dr_bit_parity` oracle — the restored cluster must
+    be bit-identical to the one that was archived."""
+    import shutil
+    import tempfile
+    import threading
+
+    from euler_tpu.distributed.service import GraphService
+    from euler_tpu.graph import Graph
+    from euler_tpu.graph import backup as bk
+    from euler_tpu.graph import wal as walmod
+
+    n, batches, rows_per = (60, 24, 64) if smoke else (2000, 120, 256)
+    rng = np.random.default_rng(23)
+    nodes = [
+        {"id": i + 1, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense",
+                       "value": rng.normal(size=8).tolist()}]}
+        for i in range(n)
+    ]
+    edges = [
+        {"src": s, "dst": s % n + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for s in range(1, n + 1)
+    ]
+    data = {"nodes": nodes, "edges": edges}
+    tmp = tempfile.mkdtemp(prefix="etpu_bench_dr_")
+
+    def tree_bytes(root: str) -> int:
+        total = 0
+        for dirpath, _dirs, files in os.walk(root):
+            for f in files:
+                total += os.path.getsize(os.path.join(dirpath, f))
+        return total
+
+    svc = None
+    # deterministic capture: snapshot explicitly mid-stream instead of
+    # letting the background cadence thread race the archive step
+    old_snap_every = os.environ.get("EULER_TPU_SNAPSHOT_EVERY")
+    os.environ["EULER_TPU_SNAPSHOT_EVERY"] = "0"
+    try:
+        wal_root = os.path.join(tmp, "wal")
+        g = Graph.from_json(data, num_partitions=1)
+        svc = GraphService(
+            g.shards[0], g.meta, 0,
+            wal_dir=os.path.join(wal_root, "shard_0"),
+        )
+        r = np.random.default_rng(7)
+        for b in range(batches):
+            src = r.integers(1, n + 1, rows_per).astype(np.uint64)
+            dst = r.integers(1, n + 1, rows_per).astype(np.uint64)
+            svc.dispatch("upsert_edges", [
+                f"dr:{b}", src, dst, np.zeros(rows_per, np.int32),
+                r.random(rows_per).astype(np.float32),
+                np.empty(0, np.uint64), np.empty(0, np.uint64),
+                np.empty(0, np.int32), np.empty(0, np.float32),
+            ])
+            if b % 6 == 5:
+                svc.dispatch("publish_epoch", [f"dr:pub:{b}"])
+            if b == batches // 2:
+                # mixed archive anchor: committed snapshot + WAL suffix
+                assert svc.snapshot_now()
+        svc.dispatch("publish_epoch", ["dr:pub:final"])
+        live = {k: np.array(v) for k, v in svc.store.arrays.items()}
+        live_epoch = svc.store.graph_epoch
+
+        # backup throughput over the durable footprint it archives
+        arch = os.path.join(tmp, "arch")
+        t0 = time.perf_counter()
+        bk.backup_cluster(bk.collect_shard_dirs(wal_root), arch)
+        backup_s = time.perf_counter() - t0
+        arch_mb = tree_bytes(arch) / 1e6
+
+        # total loss: the cluster's durable state is gone; restore, boot
+        # a fresh service on the materialized dirs (ctor auto-recovers),
+        # and serve a first read
+        svc.stop()
+        svc = None
+        shutil.rmtree(wal_root)
+        g2 = Graph.from_json(data, num_partitions=1)
+        t0 = time.perf_counter()
+        bk.restore_cluster(arch, wal_root)
+        svc = GraphService(
+            g2.shards[0], g2.meta, 0,
+            wal_dir=os.path.join(wal_root, "shard_0"),
+        )
+        svc.store.get_dense_feature(
+            np.arange(1, min(n, 64) + 1, dtype=np.uint64), ["feat"]
+        )
+        restore_ms = (time.perf_counter() - t0) * 1e3
+        parity = (
+            svc.store.graph_epoch == live_epoch
+            and set(live) == set(svc.store.arrays)
+            and all(
+                np.array_equal(np.asarray(svc.store.arrays[k]), live[k])
+                for k in live
+            )
+        )
+
+        # at-rest scrub throughput over snapshots + WAL on the restored
+        # shard, then back-to-back passes looping in the background while
+        # a reader hammers the store — the WORST-CASE interference ratio
+        # SCALE.md quotes (a real deployment scrubs on EULER_TPU_SCRUB_S
+        # cadence, so the amortized cost scales with the duty cycle)
+        shard_dir = os.path.join(wal_root, "shard_0")
+        t0 = time.perf_counter()
+        rep = svc.scrub_now()
+        scrub_s = time.perf_counter() - t0
+        scrubbed_mb = (
+            rep["wal_bytes_checked"]
+            + sum(
+                tree_bytes(os.path.join(shard_dir, d))
+                for d in os.listdir(shard_dir)
+                if walmod.is_committed_snapshot_name(d)
+            )
+        ) / 1e6
+
+        ids = np.arange(1, min(n, 64) + 1, dtype=np.uint64)
+
+        def read_rate(seconds: float) -> float:
+            count, t0 = 0, time.perf_counter()
+            while time.perf_counter() - t0 < seconds:
+                svc.store.get_dense_feature(ids, ["feat"])
+                count += 1
+            return count / (time.perf_counter() - t0)
+
+        window = 0.3 if smoke else 1.0
+        idle_rate = read_rate(window)
+        stop = threading.Event()
+
+        def scrub_loop():
+            while not stop.is_set():
+                svc.scrub_now()
+
+        t = threading.Thread(target=scrub_loop, daemon=True)
+        t.start()
+        try:
+            busy_rate = read_rate(window)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        return {
+            "dr": True,
+            "dr_bit_parity": bool(parity),
+            "dr_backup_mb_per_sec": round(
+                arch_mb / max(backup_s, 1e-9), 2
+            ),
+            "dr_archive_mb": round(arch_mb, 3),
+            "dr_restore_to_first_read_ms": round(restore_ms, 2),
+            "dr_scrub_mb_per_sec": round(
+                scrubbed_mb / max(scrub_s, 1e-9), 2
+            ),
+            "dr_read_rate_scrub_over_idle": round(
+                busy_rate / max(idle_rate, 1e-9), 3
+            ),
+        }
+    finally:
+        if old_snap_every is None:
+            os.environ.pop("EULER_TPU_SNAPSHOT_EVERY", None)
+        else:
+            os.environ["EULER_TPU_SNAPSHOT_EVERY"] = old_snap_every
+        if svc is not None:
+            svc.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run(platform: str) -> tuple[float, dict]:
     from euler_tpu.dataflow import SageDataFlow
     from euler_tpu.datasets.synthetic import random_graph
@@ -1277,6 +1446,16 @@ def run(platform: str) -> tuple[float, dict]:
             extra.update(
                 {"analytics": False, "analytics_error": repr(e)[:300]}
             )
+    # disaster-recovery lane (ISSUE 15) — backup MB/s, total-loss
+    # restore-to-first-read, scrub MB/s + reader interference, bit parity
+    if os.environ.get("EULER_BENCH_DR", "1") != "0":
+        try:
+            extra.update(_dr_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update({"dr": False, "dr_error": repr(e)[:300]})
     probe = _probe_meta()
     if probe:
         extra["probe"] = probe

@@ -81,6 +81,9 @@ DEFAULT_DOMAINS = (
             # shard replication (ISSUE 13): followers tail the primary's
             # WAL with wal_ship/wal_pos/repl_status on the same protocol
             "euler_tpu/distributed/replication.py",
+            # disaster recovery (ISSUE 15): the scrubber repairs from
+            # peers over wal_ship and the CLI triggers scrub passes
+            "euler_tpu/graph/backup.py",
         ),
         servers=("euler_tpu/distributed/service.py",),
     ),

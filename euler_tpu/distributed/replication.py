@@ -541,6 +541,12 @@ class ReplicaCoordinator:
         arrays = {
             n: np.array(a, copy=True) for n, a in zip(names, reply[5:])
         }
+        # install_snapshot writes a local snapshot through the full
+        # write_snapshot commit discipline (per-file fsync + dir fsync +
+        # atomic rename) BEFORE returning, so by the time the position
+        # below is published as this replica's durable ack, a restart of
+        # this process recovers to it without re-bootstrapping — the ack
+        # is never ahead of the disk.
         self.service.install_snapshot(epoch, arrays, applied, wal_pos)
         self.term = max(self.term, term)
         self.bootstraps += 1

@@ -413,6 +413,16 @@ class GraphService:
         # mid-delta snapshot would trim acked-but-unpublished records)
         self._snap_state: tuple | None = None
         self._snap_busy = threading.Lock()
+        # at-rest integrity (graph/backup.py, PR 15): scrub pass /
+        # corruption / repair counters plus the degraded flag, surfaced
+        # through `stats` and `repl_status` → fleet_stats. The scrubber
+        # daemon itself starts in start() when EULER_TPU_SCRUB_S > 0.
+        self.scrub_passes = 0
+        self.scrub_corruptions = 0
+        self.scrub_repairs = 0
+        self.degraded: str | None = None
+        self.last_scrub: dict | None = None
+        self._scrubber = None
         if wal_dir is not None:
             from euler_tpu.graph import wal as walmod
 
@@ -483,6 +493,15 @@ class GraphService:
             )
         if self._repl is not None:
             self._repl.start()
+        if self._wal is not None:
+            from euler_tpu.graph.backup import (
+                IntegrityScrubber,
+                scrub_cadence_s,
+            )
+
+            cadence = scrub_cadence_s()
+            if cadence > 0:
+                self._scrubber = IntegrityScrubber(self, cadence).start()
         return self
 
     def stop(self, drain_s: float | None = None):
@@ -490,6 +509,8 @@ class GraphService:
         registry FIRST (clients stop routing here), refuse new
         connections, finish in-flight work (bounded by drain_s), then
         close. drain_s=None keeps the immediate-stop behavior."""
+        if self._scrubber is not None:
+            self._scrubber.stop()
         if self._repl is not None:
             self._repl.stop()
         if self._beat is not None:
@@ -581,6 +602,7 @@ class GraphService:
         "sample_neighbor_layerwise",
         "sample_node",
         "sample_node_with_condition",
+        "scrub",
         "stats",
         "unit_edge_weights",
         "upsert_edges",
@@ -627,7 +649,26 @@ class GraphService:
                 "wal_bytes": self._wal.size() if self._wal else 0,
                 "last_snapshot_epoch": self._last_snapshot_epoch,
                 "recovering": bool(self.recovering),
+                # at-rest integrity (PR 15): scrub counters, the
+                # degraded flag (null = healthy), and any snapshot
+                # corpses recovery quarantined at boot
+                "scrub_passes": int(self.scrub_passes),
+                "scrub_corruptions": int(self.scrub_corruptions),
+                "scrub_repairs": int(self.scrub_repairs),
+                "degraded": self.degraded,
+                "snapshots_quarantined": (
+                    (self.recovery_report or {}).get(
+                        "snapshots_quarantined", []
+                    )
+                ),
             })]
+        if op == "scrub":
+            # one synchronous at-rest integrity pass (graph/backup.py):
+            # verify snapshot crc manifests + re-parse the WAL,
+            # quarantine/repair, return the report. a[0] (optional)
+            # False = detect-only, no repair attempts.
+            repair = bool(a[0]) if a else True
+            return [json.dumps(self.scrub_now(repair=repair))]
         if op == "repl_status":
             # replication introspection: role/term/position/primary —
             # the writer's primary-discovery verb and the ops dashboard
@@ -1122,6 +1163,14 @@ class GraphService:
         self._snapshot_run()
         return True
 
+    def scrub_now(self, repair: bool = True) -> dict:
+        """One synchronous integrity pass over this shard's at-rest
+        artifacts (operators, tests, the `scrub` verb). No-op report
+        when the shard has no WAL dir."""
+        from euler_tpu.graph import backup as backupmod
+
+        return backupmod.scrub_service(self, repair=repair)
+
     # -- replication (distributed/replication.py) ------------------------
 
     def repl_status(self) -> dict:
@@ -1139,6 +1188,12 @@ class GraphService:
             "wal_base": int(self._wal.base) if self._wal else 0,
             "wal_end": int(self._wal.tell()) if self._wal else 0,
             "graph_epoch": int(getattr(self.store, "graph_epoch", 0)),
+            # at-rest integrity (PR 15): ops dashboards read the
+            # degraded flag and scrub counters off the same row
+            "degraded": self.degraded,
+            "scrub_passes": int(self.scrub_passes),
+            "scrub_corruptions": int(self.scrub_corruptions),
+            "scrub_repairs": int(self.scrub_repairs),
         }
         if self._repl is not None:
             st.update(self._repl.status())

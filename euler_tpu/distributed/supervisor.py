@@ -104,6 +104,7 @@ class ShardSupervisor:
         poll_s: float = 0.1,
         native: bool = False,
         env: dict | None = None,
+        scrub_s: float | None = None,
     ):
         self.data_dir = data_dir
         self.num_shards = int(num_shards)
@@ -117,6 +118,9 @@ class ShardSupervisor:
         self.poll_s = float(poll_s)
         self.native = native
         self.env = dict(env) if env else None
+        # at-rest integrity cadence for every child (EULER_TPU_SCRUB_S;
+        # None inherits the supervisor's environment, 0 disables)
+        self.scrub_s = scrub_s
         os.makedirs(wal_root, exist_ok=True)
         ports = (
             list(ports)
@@ -152,6 +156,8 @@ class ShardSupervisor:
         sh.log_path = os.path.join(self.wal_root, f"shard_{sh.shard}.log")
         env = dict(os.environ if self.env is None else self.env)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if self.scrub_s is not None:
+            env["EULER_TPU_SCRUB_S"] = str(self.scrub_s)
         log = open(sh.log_path, "ab")
         try:
             # its own session: a Ctrl-C to the supervisor's group must
@@ -348,6 +354,7 @@ class ReplicaGroupSupervisor:
         poll_s: float = 0.1,
         native: bool = False,
         env: dict | None = None,
+        scrub_s: float | None = None,
     ):
         self.data_dir = data_dir
         self.num_shards = int(num_shards)
@@ -365,6 +372,8 @@ class ReplicaGroupSupervisor:
         self.poll_s = float(poll_s)
         self.native = native
         self.env = dict(env) if env else None
+        # integrity-scrub cadence forwarded to children as EULER_TPU_SCRUB_S
+        self.scrub_s = scrub_s
         os.makedirs(wal_root, exist_ok=True)
         self.members = [
             _Member(
@@ -410,6 +419,8 @@ class ReplicaGroupSupervisor:
         )
         env = dict(os.environ if self.env is None else self.env)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if self.scrub_s is not None:
+            env["EULER_TPU_SCRUB_S"] = str(self.scrub_s)
         log = open(m.log_path, "ab")
         try:
             # graftlint: disable=lock-unguarded-write -- every caller holds self._lock around _spawn

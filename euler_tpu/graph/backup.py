@@ -1,0 +1,873 @@
+"""Disaster recovery for durable graph clusters: epoch-consistent
+backup, point-in-time restore, and the at-rest integrity scrubber.
+
+PRs 9/10/13 made every process survive `kill -9`, but the durability
+ladder stopped at the local disk: losing a shard's WAL dir, silent
+bit-rot in a snapshot at rest, a fat-finger publish, or total cluster
+loss were unrecoverable. Euler 2.0 ships its graph as durable
+partitioned artifacts with an offline build/restore path (PAPER.md);
+this module is that layer for the streaming-mutation lane, in three
+pillars:
+
+- **Backup** (`backup_cluster`): per shard, every committed snapshot
+  (verified against its crc manifest first — rot is never archived)
+  plus the WAL slice cut at its valid record prefix (the epoch-
+  consistent capture point under live writers), the trainer's newest
+  COMMIT-complete checkpoint, and a topology manifest with a per-file
+  crc32 of everything. The archive dir commits tmp → fsync → rename,
+  the same discipline as the snapshots it contains.
+- **Point-in-time restore** (`restore_cluster`): materializes fresh
+  `--wal-dir`s from the archive. The target-epoch cut is found by
+  replaying the archived records through `epoch_timeline`, which
+  mirrors `wal.recover`'s control flow exactly (same DeltaStore
+  staging, same applied-window skips), so a cluster booted from the
+  restored dirs via the normal `recover()` path lands bit-identical on
+  the requested published epoch. `--epoch E-1` is the fat-finger
+  publish rollback; at-head restore (no epoch) keeps the pending
+  staged-but-unpublished delta too.
+- **Scrubber** (`IntegrityScrubber` / `scrub_service`): a low-priority
+  per-shard pass that re-verifies snapshot crc manifests and re-parses
+  the WAL at rest on an `EULER_TPU_SCRUB_S` cadence. At-rest rot never
+  corrupts serving (records were applied to memory when written), but
+  it WOULD lose the suffix on the next restart — so corrupt artifacts
+  are quarantined (renamed `*.corrupt`, never deleted), snapshots are
+  repaired locally (re-snapshot the last published state) or adopted
+  from a live replica-group peer (`wal_ship` want=snapshot →
+  `install_snapshot`), and rotten WAL byte ranges are re-fetched from
+  a peer's byte-interchangeable log and spliced back in place. With no
+  peer and no local repair, the shard is marked degraded (typed
+  telemetry through `stats`/`repl_status` → `fleet_stats`) — it keeps
+  serving its in-memory state and never silently serves corrupt bytes.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from euler_tpu.graph import wal as walmod
+
+# Verbs this module puts on the wire: the remote scrub trigger
+# (`scrub_remote`) and the peer-repair channel (`wal_ship`, reusing the
+# PR-13 replication verb for both byte-range fetch and snapshot
+# adoption). graftlint's wire-protocol checker diffs the union of
+# client tables against GraphService.HANDLED_VERBS; the runtime twin
+# lives in tests/test_wire_parity.py.
+WIRE_VERBS = frozenset({
+    "scrub",
+    "wal_ship",
+})
+
+ARCHIVE_MANIFEST = "manifest.json"
+ARCHIVE_VERSION = 1
+
+
+def scrub_cadence_s() -> float:
+    """EULER_TPU_SCRUB_S: background integrity-scrub cadence in seconds
+    (0 = off, the default — operators and the supervisor opt in)."""
+    return float(os.environ.get("EULER_TPU_SCRUB_S", "0"))
+
+
+# ---------------------------------------------------------------------------
+# epoch timeline — the PITR cut finder
+# ---------------------------------------------------------------------------
+
+
+def epoch_timeline(
+    records,
+    start_epoch: int,
+    applied,
+    part: int,
+    num_partitions: int,
+    applied_keys_max: int = 4096,
+) -> list[tuple[int, int]]:
+    """[(end_logical, epoch_after_record)] for each record, mirroring
+    `wal.recover`'s replay control flow EXACTLY: publish records bump
+    the epoch only when the pending delta is non-empty, applied-window
+    keys skip re-staging and re-publishing, and the window FIFO-caps
+    identically. Staging goes through a real DeltaStore so the `empty`
+    semantics can never diverge from the live path. The cut position
+    for a target epoch E is the end of the publish record whose
+    epoch_after first equals E — everything after it (later mutations,
+    the fat-fingered publish) is excluded by construction."""
+    from euler_tpu.graph.delta import DeltaStore
+
+    applied = collections.OrderedDict(applied)
+    epoch = int(start_epoch)
+    delta = None
+    out: list[tuple[int, int]] = []
+    for op, a, end in records:
+        if op == "publish_epoch":
+            key = a[0] if a else None
+            if key is not None and f"pub:{key}" in applied:
+                out.append((int(end), epoch))
+                continue
+            d, delta = delta, None
+            if not (d is None or d.empty):
+                epoch += 1
+            if key is not None:
+                applied[f"pub:{key}"] = (epoch,)
+        else:
+            key = str(a[0])
+            if key in applied:
+                out.append((int(end), epoch))
+                continue
+            if delta is None:
+                delta = DeltaStore(part, num_partitions, max_rows=2**62)
+            walmod.stage_record(delta, op, a)
+            applied[key] = True
+        while len(applied) > applied_keys_max:
+            applied.popitem(last=False)
+        out.append((int(end), epoch))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# archive: backup
+# ---------------------------------------------------------------------------
+
+
+def _fsync_tree(root: str) -> None:
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in filenames:
+            fd = os.open(os.path.join(dirpath, fn), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        fd = os.open(dirpath, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+def _crc_walk(base_dir: str) -> dict[str, int]:
+    out = {}
+    for dirpath, _dirnames, filenames in os.walk(base_dir):
+        for fn in filenames:
+            p = os.path.join(dirpath, fn)
+            out[os.path.relpath(p, base_dir)] = walmod._crc_file(p)
+    return out
+
+
+def collect_shard_dirs(wal_root: str) -> dict[int, str]:
+    """Map shard id → the WAL dir to capture, handling both supervisor
+    layouts: `shard_<i>/` holding wal.log directly (solo shards) and
+    `shard_<i>/replica_<r>/` groups (PR 13) — replica logs are byte-
+    interchangeable, so any member is a correct capture source; the one
+    with the longest valid log is the freshest."""
+    out: dict[int, str] = {}
+    for name in sorted(os.listdir(wal_root)):
+        if not name.startswith("shard_"):
+            continue
+        sdir = os.path.join(wal_root, name)
+        if not os.path.isdir(sdir):
+            continue
+        try:
+            sid = int(name.split("_", 1)[1])
+        except ValueError:
+            continue
+        if os.path.exists(os.path.join(sdir, walmod.WAL_FILE)):
+            out[sid] = sdir
+            continue
+        reps = [
+            os.path.join(sdir, r)
+            for r in sorted(os.listdir(sdir))
+            if r.startswith("replica_")
+            and os.path.exists(os.path.join(sdir, r, walmod.WAL_FILE))
+        ]
+        if reps:
+            out[sid] = max(reps, key=_wal_horizon)
+    return out
+
+
+def _wal_horizon(wdir: str) -> int:
+    try:
+        _records, _base, valid_end = walmod.scan(
+            os.path.join(wdir, walmod.WAL_FILE)
+        )
+        return valid_end
+    except (OSError, ValueError):
+        return -1
+
+
+def _start_candidates(shard_dir: str, snap_names, wal_base: int) -> list:
+    """Replay anchors available in `shard_dir`, ascending by epoch:
+    (snap_name | None, epoch, applied, wal_pos). The None anchor is the
+    construction-time source graph — only valid when the log still
+    starts at 0 (nothing was trimmed into a snapshot)."""
+    out = []
+    if wal_base == 0:
+        out.append((None, 0, collections.OrderedDict(), 0))
+    for name in sorted(snap_names):
+        d = os.path.join(shard_dir, name)
+        try:
+            with open(os.path.join(d, "snapshot.json")) as f:
+                meta = json.load(f)
+            pos = int(meta["wal_pos"])
+            if pos < wal_base:
+                continue  # its replay suffix is gone: not an anchor
+            with open(os.path.join(d, "applied.bin"), "rb") as f:
+                applied = walmod._applied_from_blob(f.read())
+            out.append((name, int(meta["epoch"]), applied, pos))
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def backup_cluster(
+    shard_dirs: dict[int, str],
+    out_dir: str,
+    model_dir: str | None = None,
+    data_dir: str | None = None,
+) -> dict:
+    """Capture an epoch-consistent archive of a (possibly live) cluster.
+
+    Per shard: every committed snapshot that passes its crc manifest
+    (provably rotten dirs are never archived) plus the WAL copied and
+    cut at its valid record prefix — the capture point; records a live
+    writer appends after the copy simply aren't in this archive. The
+    trainer's newest COMMIT-complete checkpoint rides along when
+    `model_dir` is given. A topology manifest with per-file crc32s is
+    written last, then the archive commits tmp → fsync → rename, so a
+    half-written archive is never mistaken for a backup."""
+    if os.path.exists(out_dir):
+        raise FileExistsError(f"archive target {out_dir} already exists")
+    num_shards = len(shard_dirs)
+    if num_shards == 0:
+        raise ValueError("backup_cluster: no shard WAL dirs to capture")
+    tmp = out_dir.rstrip("/\\") + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    manifest: dict = {
+        "version": ARCHIVE_VERSION,
+        "created_ts": time.time(),
+        "num_shards": num_shards,
+        "data_dir": data_dir,
+        "shards": {},
+        "trainer": None,
+    }
+    for sid, wdir in sorted(shard_dirs.items()):
+        dst = os.path.join(tmp, f"shard_{int(sid)}")
+        os.makedirs(dst)
+        # WAL first, snapshots second: a background snapshot commits
+        # BEFORE it trims the log, so any trim visible in our WAL copy
+        # implies its covering snapshot is already on disk for the
+        # listing below — the reverse order can capture a just-trimmed
+        # log with no archived anchor
+        wal_src = os.path.join(wdir, walmod.WAL_FILE)
+        wal_dst = os.path.join(dst, walmod.WAL_FILE)
+        if os.path.exists(wal_src):
+            shutil.copyfile(wal_src, wal_dst)
+            # a live writer may be mid-append: cut OUR COPY back to its
+            # valid record prefix — the epoch-consistent capture point
+            walmod.truncate_torn_tail(wal_dst)
+        else:
+            with open(wal_dst, "wb") as f:
+                f.write(walmod._HEADER.pack(walmod.MAGIC, 0))
+        records, base, valid_end = walmod.scan(wal_dst)
+        snaps = []
+        names = sorted(os.listdir(wdir)) if os.path.isdir(wdir) else []
+        for name in names:
+            if not walmod.is_committed_snapshot_name(name):
+                continue
+            src = os.path.join(wdir, name)
+            if walmod.verify_snapshot(src):  # [] (clean) and None both pass
+                continue
+            shutil.copytree(src, os.path.join(dst, name))
+            snaps.append(name)
+        cand = [
+            c for c in _start_candidates(dst, snaps, base)
+            # a snapshot committed AFTER our WAL copy can cover a
+            # position past the copy's end; it can't anchor THIS archive
+            if c[3] <= valid_end
+        ]
+        if not cand:
+            raise RuntimeError(
+                f"shard {sid}: WAL base {base} > 0 but no usable snapshot"
+                " was archived — this archive could never restore; fix the"
+                " shard (scrub/repair) and re-run the backup"
+            )
+        _name0, e0, applied0, p0 = cand[-1]
+        tl = epoch_timeline(
+            [r for r in records if r[2] > p0], e0, applied0, sid, num_shards
+        )
+        manifest["shards"][str(int(sid))] = {
+            "wal_base": int(base),
+            "wal_end": int(valid_end),
+            "epoch": int(tl[-1][1] if tl else e0),
+            "earliest_epoch": int(cand[0][1]),
+            "snapshots": snaps,
+            "files": _crc_walk(dst),
+        }
+    if model_dir is not None:
+        from euler_tpu.training.checkpoint import latest_complete
+
+        ck = latest_complete(model_dir)
+        if ck is not None:
+            dst = os.path.join(tmp, "trainer", os.path.basename(ck))
+            shutil.copytree(ck, dst)
+            manifest["trainer"] = {
+                "checkpoint": os.path.basename(ck),
+                "files": _crc_walk(dst),
+            }
+    with open(os.path.join(tmp, ARCHIVE_MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_tree(tmp)
+    os.replace(tmp, out_dir)
+    parent = os.path.dirname(os.path.abspath(out_dir)) or "."
+    dfd = os.open(parent, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# archive: verify + restore
+# ---------------------------------------------------------------------------
+
+
+def verify_archive(archive_dir: str) -> dict:
+    """Re-hash every archived file against the manifest. Returns
+    {"ok", "bad_files", "files_checked", "manifest"} — restore refuses
+    a failing archive, and `tools/backup.py verify` surfaces this."""
+    with open(os.path.join(archive_dir, ARCHIVE_MANIFEST)) as f:
+        manifest = json.load(f)
+    bad: list[str] = []
+    checked = 0
+
+    def check(base_dir: str, files: dict, prefix: str) -> None:
+        nonlocal checked
+        for rel in sorted(files):
+            checked += 1
+            p = os.path.join(base_dir, rel)
+            try:
+                got = walmod._crc_file(p)
+            except OSError:
+                bad.append(f"{prefix}/{rel} (missing)")
+                continue
+            if got != int(files[rel]):
+                bad.append(f"{prefix}/{rel}")
+
+    for sid in sorted(manifest["shards"], key=int):
+        check(
+            os.path.join(archive_dir, f"shard_{int(sid)}"),
+            manifest["shards"][sid]["files"],
+            f"shard_{int(sid)}",
+        )
+    tr = manifest.get("trainer")
+    if tr:
+        check(
+            os.path.join(archive_dir, "trainer", tr["checkpoint"]),
+            tr["files"],
+            "trainer",
+        )
+    return {
+        "ok": not bad,
+        "bad_files": bad,
+        "files_checked": checked,
+        "manifest": manifest,
+    }
+
+
+def read_archive_wal(path: str, expect_crc: int | None = None):
+    """Archived WAL slice → (records, base, valid_end). Unlike the live
+    `scan` (which tolerates a torn tail by design), an archived slice
+    was cut at a record boundary when captured, so ANY damage — a
+    whole-file crc mismatch against the manifest, a broken header, or a
+    record failing its crc before the recorded end — raises ValueError
+    instead of silently restoring a shorter history."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if (
+        expect_crc is not None
+        and zlib.crc32(blob) & 0xFFFFFFFF != int(expect_crc)
+    ):
+        raise ValueError(f"{path}: archived WAL slice fails its manifest crc")
+    if len(blob) < walmod._HEADER.size:
+        raise ValueError(f"{path}: archived WAL slice shorter than a header")
+    magic, base = walmod._HEADER.unpack_from(blob, 0)
+    if magic != walmod.MAGIC:
+        raise ValueError(f"{path}: not a WAL slice (bad magic)")
+    records4, valid_end = walmod.parse_records(
+        blob[walmod._HEADER.size:], int(base)
+    )
+    if walmod._HEADER.size + (valid_end - int(base)) != len(blob):
+        raise ValueError(
+            f"{path}: corrupt record in archived WAL slice at logical"
+            f" {valid_end}"
+        )
+    return (
+        [(op, v, end) for op, v, end, _t in records4],
+        int(base),
+        int(valid_end),
+    )
+
+
+def restore_cluster(
+    archive_dir: str,
+    out_root: str,
+    epoch: int | None = None,
+    replication: int = 1,
+    model_dir: str | None = None,
+) -> dict:
+    """Materialize fresh per-shard WAL dirs from an archive so a normal
+    boot (`recover()` per shard) lands EXACTLY on the target epoch.
+
+    `epoch=None` restores at head: newest snapshot + the full archived
+    suffix, pending un-published delta and applied window included.
+    `epoch=E` is point-in-time: per shard, the newest archived anchor
+    with epoch ≤ E plus the record suffix cut at the publish that lands
+    epoch E (`epoch_timeline`) — later records, including the
+    fat-fingered publish being rolled back, never reach the restored
+    dir. `replication=R` materializes R identical replica dirs per
+    shard (`shard_<s>/replica_<r>`) — logs are byte-interchangeable, so
+    a replica group boots straight from them. The archive is fully
+    crc-verified first; damage raises instead of restoring garbage."""
+    v = verify_archive(archive_dir)
+    if not v["ok"]:
+        raise ValueError(
+            f"{archive_dir}: archive failed verification — damaged files:"
+            f" {v['bad_files'][:8]}"
+        )
+    manifest = v["manifest"]
+    num_shards = int(manifest["num_shards"])
+    replication = max(1, int(replication))
+    report: dict = {
+        "archive": archive_dir,
+        "out_root": out_root,
+        "epoch": None if epoch is None else int(epoch),
+        "replication": replication,
+        "shards": {},
+        "trainer": None,
+    }
+    for sid_str in sorted(manifest["shards"], key=int):
+        sid = int(sid_str)
+        entry = manifest["shards"][sid_str]
+        src = os.path.join(archive_dir, f"shard_{sid}")
+        wal_src = os.path.join(src, walmod.WAL_FILE)
+        records, base, valid_end = read_archive_wal(
+            wal_src, expect_crc=entry["files"][walmod.WAL_FILE]
+        )
+        cand = [
+            c for c in _start_candidates(src, entry.get("snapshots", []), base)
+            # ride along only: an archived snapshot covering a position
+            # past the archived WAL has no replay suffix here
+            if c[3] <= valid_end
+        ]
+        feasible = [c for c in cand if epoch is None or c[1] <= int(epoch)]
+        if not feasible:
+            raise ValueError(
+                f"shard {sid}: --epoch {epoch} predates the archive horizon"
+                f" (earliest restorable epoch"
+                f" {cand[0][1] if cand else 'none'})"
+            )
+        name0, e0, applied0, p0 = feasible[-1]
+        suffix = [r for r in records if r[2] > p0]
+        tl = epoch_timeline(suffix, e0, applied0, sid, num_shards)
+        final_epoch = tl[-1][1] if tl else e0
+        if epoch is None:
+            cut, reached = valid_end, final_epoch
+        elif int(epoch) == e0:
+            cut, reached = p0, e0
+        else:
+            hit = next(
+                ((end, ep) for end, ep in tl if ep == int(epoch)), None
+            )
+            if hit is None:
+                raise ValueError(
+                    f"shard {sid}: epoch {epoch} is not in the archive"
+                    f" horizon [{cand[0][1]}, {final_epoch}]"
+                )
+            cut, reached = hit[0], int(epoch)
+        dests = []
+        for r in range(replication):
+            dest = (
+                os.path.join(out_root, f"shard_{sid}", f"replica_{r}")
+                if replication > 1
+                else os.path.join(out_root, f"shard_{sid}")
+            )
+            _materialize_shard(src, name0, p0, cut, wal_src, base, dest)
+            dests.append(dest)
+        report["shards"][sid] = {
+            "epoch": int(reached),
+            "snapshot": name0,
+            "wal_bytes": int(cut - p0),
+            "dests": dests,
+        }
+    tr = manifest.get("trainer")
+    if tr and model_dir is not None:
+        src = os.path.join(archive_dir, "trainer", tr["checkpoint"])
+        dst = os.path.join(model_dir, tr["checkpoint"])
+        if os.path.exists(dst):
+            raise FileExistsError(f"restore target {dst} already exists")
+        os.makedirs(model_dir, exist_ok=True)
+        shutil.copytree(src, dst)
+        _fsync_tree(dst)
+        report["trainer"] = {"checkpoint": tr["checkpoint"], "dest": dst}
+    return report
+
+
+def _materialize_shard(
+    src: str,
+    snap_name: str | None,
+    start: int,
+    cut: int,
+    wal_src: str,
+    arch_base: int,
+    dest: str,
+) -> None:
+    """One restored WAL dir: the chosen snapshot anchor (if any) plus a
+    fresh wal.log whose header base is the anchor position, holding the
+    archived record bytes [start, cut). `recover()` then replays it the
+    normal way — restore invents no second recovery path."""
+    if os.path.exists(os.path.join(dest, walmod.WAL_FILE)):
+        raise FileExistsError(f"restore target {dest} already has a WAL")
+    os.makedirs(dest, exist_ok=True)
+    if snap_name is not None:
+        shutil.copytree(os.path.join(src, snap_name),
+                        os.path.join(dest, snap_name))
+    with open(wal_src, "rb") as f:
+        f.seek(walmod._HEADER.size + (start - arch_base))
+        blob = f.read(cut - start)
+    tmp = os.path.join(dest, walmod.WAL_FILE + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(walmod._HEADER.pack(walmod.MAGIC, int(start)))
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dest, walmod.WAL_FILE))
+    _fsync_tree(dest)
+
+
+# ---------------------------------------------------------------------------
+# integrity scrubber
+# ---------------------------------------------------------------------------
+
+
+def scrub_remote(host: str, port: int) -> dict:
+    """Trigger one synchronous scrub pass on a remote shard (the CLI's
+    `scrub` subcommand) and return its report."""
+    from euler_tpu.distributed.replication import _PrimaryLink
+
+    link = _PrimaryLink(host, int(port))
+    try:
+        reply = link._call("scrub", [])
+        return json.loads(reply[0])
+    finally:
+        link.close()
+
+
+def _peer_addrs(svc) -> list[tuple[str, int]]:
+    """Live repair peers for this shard: the known primary first (a
+    follower's freshest source), then every registry member of the same
+    shard group. Solo shards without a registry have none — scrub then
+    degrades instead of repairing."""
+    me = (svc.host, svc.port)
+    out: list[tuple[str, int]] = []
+    repl = getattr(svc, "_repl", None)
+    if repl is not None and repl.primary_addr:
+        pa = (repl.primary_addr[0], int(repl.primary_addr[1]))
+        if pa != me:
+            out.append(pa)
+    reg = getattr(svc, "registry", None)
+    if reg is not None:
+        try:
+            for host, port, _meta in reg.members(svc.shard):
+                addr = (host, int(port))
+                if addr != me and addr not in out:
+                    out.append(addr)
+        except Exception:
+            pass
+    return out
+
+
+def _install_from_peer(svc, addr: tuple[str, int]) -> bool:
+    """Adopt a peer's newest publish-consistent snapshot over the wire
+    (the PR-13 bootstrap payload → `install_snapshot`, which writes a
+    fresh durable local snapshot before returning)."""
+    from euler_tpu.distributed.replication import _PrimaryLink
+
+    link = _PrimaryLink(addr[0], int(addr[1]))
+    try:
+        reply = link._call("wal_ship", [0, 0, None, "snapshot"])
+        epoch, pos = int(reply[1]), int(reply[2])
+        applied = walmod._applied_from_blob(
+            bytes(np.ascontiguousarray(reply[3]))
+        )
+        names = json.loads(reply[4])
+        arrays = {
+            n: np.array(a, copy=True) for n, a in zip(names, reply[5:])
+        }
+        svc.install_snapshot(epoch, arrays, applied, pos)
+        return True
+    finally:
+        link.close()
+
+
+def _fetch_wal_range(wal, addr, frm: int, to: int, max_bytes: int = 1 << 20):
+    """Fetch the byte range [frm, to) of a peer's log over `wal_ship`.
+    Replica logs are byte-interchangeable (`append_raw` verbatim), so
+    the peer's bytes are OUR bytes; the first request carries the crc
+    handshake of our intact local prefix so a divergent history answers
+    need_snapshot instead of handing us someone else's suffix. Returns
+    None when this peer can't serve the range (trimmed, divergent, or
+    short); the fetched bytes must parse as whole records ending
+    exactly at `to`."""
+    from euler_tpu.distributed.replication import _PrimaryLink
+
+    link = _PrimaryLink(addr[0], int(addr[1]))
+    try:
+        out = b""
+        pos = frm
+        tail_len = min(4096, frm - wal.base)
+        tail_crc = wal.crc_range(frm - tail_len, frm) if tail_len > 0 else 0
+        while pos < to:
+            t_crc, t_len = (tail_crc, tail_len) if pos == frm else (0, 0)
+            reply = link._call(
+                "wal_ship", [pos, max_bytes, None, "log", t_crc, t_len, 0.0]
+            )
+            if bool(reply[3]):
+                return None  # peer needs us to snapshot: range unserveable
+            blob = bytes(np.ascontiguousarray(reply[1]))
+            if not blob:
+                return None  # peer's log ends before our range does
+            out += blob
+            pos = int(reply[2])
+        out = out[: to - frm]
+        _records, vend = walmod.parse_records(out, frm)
+        if vend != to:
+            return None  # cut must land on OUR record boundary at `to`
+        return out
+    finally:
+        link.close()
+
+
+def _has_restart_anchor(wal_dir: str, min_pos: int) -> bool:
+    """Can a cold restart of this shard recover? True when the log
+    still starts at 0 (source replay) or some committed snapshot at/
+    after the base verifies clean."""
+    if min_pos == 0:
+        return True
+    for name in sorted(os.listdir(wal_dir), reverse=True):
+        if not walmod.is_committed_snapshot_name(name):
+            continue
+        d = os.path.join(wal_dir, name)
+        try:
+            with open(os.path.join(d, "snapshot.json")) as f:
+                if int(json.load(f)["wal_pos"]) < min_pos:
+                    continue
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            continue
+        if not walmod.verify_snapshot(d):  # [] or None: not provably bad
+            return True
+    return False
+
+
+def scrub_service(svc, repair: bool = True) -> dict:
+    """One integrity pass over a live service's at-rest artifacts.
+
+    Detection: every committed snapshot dir re-hashed against its crc
+    manifest; the WAL file re-parsed end to end. Quarantine: provably
+    corrupt artifacts renamed `*.corrupt` (snapshots moved out of the
+    fallback chain; the WAL copied aside since the live file must keep
+    serving). Repair: snapshots from the local published state
+    (`snapshot_now`) or a peer's (`install_snapshot`); WAL byte ranges
+    re-fetched from a peer's byte-interchangeable log and spliced in
+    place — at-rest rot never touched the in-memory state, so repair
+    restores BYTES, not state. Unrepairable rot that would strand a
+    restart marks the shard degraded (telemetry, never an error leak
+    on the serve path)."""
+    report: dict = {
+        "shard": int(svc.shard),
+        "snapshots_checked": 0,
+        "wal_bytes_checked": 0,
+        "bytes_scanned": 0,
+        "corruptions": [],
+        "repairs": [],
+        "degraded": None,
+    }
+    wal = getattr(svc, "_wal", None)
+    wal_dir = getattr(svc, "wal_dir", None)
+    if wal is None or wal_dir is None:
+        svc.scrub_passes += 1
+        return report
+    # -- WAL at rest (checked FIRST: snapshot repair below re-snapshots
+    # and trims the log, which would silently discard — not detect —
+    # any rot sitting in the soon-to-be-trimmed region) ------------------
+    v = wal.verify()
+    report["wal_bytes_checked"] = int(v["end"] - wal.base)
+    report["bytes_scanned"] += int(v["end"] - wal.base)
+    wal_repaired = v["ok"]
+    if not v["ok"]:
+        svc.scrub_corruptions += 1
+        report["corruptions"].append({
+            "artifact": walmod.WAL_FILE,
+            "valid_end": int(v["valid_end"]),
+            "end": int(v["end"]),
+            "header_ok": bool(v["header_ok"]),
+        })
+        if repair:
+            for addr in _peer_addrs(svc):
+                try:
+                    data = _fetch_wal_range(
+                        wal, addr, int(v["valid_end"]), int(v["end"])
+                    )
+                except Exception:
+                    continue
+                if data is None:
+                    continue
+                # quarantine by COPY: the live file must keep serving
+                # while we hold evidence of the rot
+                qdst = wal.path + walmod.CORRUPT_SUFFIX
+                n = 1
+                while os.path.exists(qdst):
+                    qdst = f"{wal.path}{walmod.CORRUPT_SUFFIX}.{n}"
+                    n += 1
+                shutil.copyfile(wal.path, qdst)
+                try:
+                    wal.splice(int(v["valid_end"]), int(v["end"]), data)
+                except ValueError:
+                    # the log moved under us — a concurrent trim, or the
+                    # replication continuity handshake spotted the same
+                    # rot and re-bootstrapped. The final re-verify below
+                    # decides whether the shard is healthy.
+                    break
+                svc.scrub_repairs += 1
+                wal_repaired = True
+                report["repairs"].append({
+                    "artifact": walmod.WAL_FILE,
+                    "via": f"peer {addr[0]}:{addr[1]}",
+                    "bytes": len(data),
+                    "quarantined_to": os.path.basename(qdst),
+                })
+                break
+        if repair and not wal_repaired:
+            # a live follower may have healed underneath us: its ship
+            # handshake covers the rotted tail, so the primary answered
+            # need_snapshot and the coordinator re-bootstrapped (reset
+            # log + fresh snapshot) while we were fetching
+            v2 = wal.verify()
+            if v2["ok"]:
+                svc.scrub_repairs += 1
+                wal_repaired = True
+                report["repairs"].append({
+                    "artifact": walmod.WAL_FILE,
+                    "via": "replication bootstrap",
+                    "bytes": 0,
+                })
+    # -- snapshots at rest ----------------------------------------------
+    snaps = sorted(
+        n for n in os.listdir(wal_dir)
+        if walmod.is_committed_snapshot_name(n)
+    )
+    snap_rot = False
+    for name in snaps:
+        d = os.path.join(wal_dir, name)
+        bad = walmod.verify_snapshot(d)
+        if bad is None:
+            continue  # pre-manifest snapshot: unverifiable, never touched
+        report["snapshots_checked"] += 1
+        size = sum(
+            os.path.getsize(os.path.join(d, f))
+            for f in os.listdir(d)
+            if os.path.isfile(os.path.join(d, f))
+        )
+        report["bytes_scanned"] += size
+        if not bad:
+            continue
+        q = walmod.quarantine_artifact(d)
+        snap_rot = True
+        svc.scrub_corruptions += 1
+        report["corruptions"].append({
+            "artifact": name,
+            "files": bad,
+            "quarantined_to": os.path.basename(q) if q else None,
+        })
+    if snap_rot and repair:
+        if svc.snapshot_now():
+            svc.scrub_repairs += 1
+            report["repairs"].append(
+                {"artifact": "snapshot", "via": "local_resnapshot"}
+            )
+        else:
+            for addr in _peer_addrs(svc):
+                try:
+                    if _install_from_peer(svc, addr):
+                        svc.scrub_repairs += 1
+                        report["repairs"].append({
+                            "artifact": "snapshot",
+                            "via": f"peer {addr[0]}:{addr[1]}",
+                        })
+                        break
+                except Exception:
+                    continue
+    # -- restartability verdict -----------------------------------------
+    degraded = None
+    if not wal_repaired:
+        degraded = (
+            f"wal-at-rest-corruption at logical {int(v['valid_end'])}"
+            " (no peer could repair); a restart would lose the suffix"
+        )
+    elif not _has_restart_anchor(wal_dir, wal.base):
+        degraded = (
+            f"no usable snapshot covers WAL base {int(wal.base)}"
+            " (no peer could repair); a restart cannot recover"
+        )
+    report["degraded"] = degraded
+    svc.degraded = degraded
+    svc.scrub_passes += 1
+    svc.last_scrub = report
+    return report
+
+
+class IntegrityScrubber:
+    """Low-priority background scrub daemon for one shard: runs
+    `scrub_service` every `interval_s` (EULER_TPU_SCRUB_S) until
+    stopped. Failures are contained — a scrub pass must never take the
+    serve path down with it."""
+
+    def __init__(self, service, interval_s: float):
+        self.service = service
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "IntegrityScrubber":
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"shard{self.service.shard}-scrub",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                scrub_service(self.service)
+            except Exception as e:  # contained: telemetry, not a crash
+                print(
+                    f"# shard {self.service.shard}: scrub pass failed"
+                    f" ({e!r}); artifacts untouched",
+                    file=sys.stderr,
+                )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

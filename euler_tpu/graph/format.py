@@ -50,8 +50,15 @@ def _align(n: int) -> int:
     return (n + ALIGN - 1) // ALIGN * ALIGN
 
 
-def write_arrays(path: str, arrays: dict[str, np.ndarray]) -> None:
-    """Write `arrays` as a tensor dir at `path` (created if needed)."""
+def write_arrays(
+    path: str, arrays: dict[str, np.ndarray], fsync: bool = False
+) -> None:
+    """Write `arrays` as a tensor dir at `path` (created if needed).
+
+    `fsync=True` flushes every file to stable storage before returning —
+    required when the tensor dir is part of a durability commit (WAL
+    snapshots, replica bootstrap): the caller's rename is only a commit
+    point if the renamed bytes are already on disk."""
     os.makedirs(path, exist_ok=True)
     index = []
     offset = 0
@@ -75,6 +82,9 @@ def write_arrays(path: str, arrays: dict[str, np.ndarray]) -> None:
         for meta, (name, arr) in zip(index, arrays.items()):
             f.seek(meta["offset"])
             f.write(np.ascontiguousarray(arr).tobytes())
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
 
     with open(os.path.join(path, "tensors.idx"), "wb") as f:
         f.write(MAGIC)
@@ -87,9 +97,15 @@ def write_arrays(path: str, arrays: dict[str, np.ndarray]) -> None:
             for d in meta["shape"]:
                 f.write(struct.pack("<q", d))
             f.write(struct.pack("<qq", meta["offset"], meta["nbytes"]))
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
 
     with open(os.path.join(path, "tensors.json"), "w") as f:
         json.dump({"version": 1, "arrays": index}, f, indent=1)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
 
 
 def read_arrays(path: str, mmap: bool = True) -> dict[str, np.ndarray]:

@@ -339,6 +339,74 @@ class WriteAheadLog:
             self._size = len(suffix)
             return keep_from
 
+    # -- at-rest integrity (PR 15 scrubber) ------------------------------
+
+    def verify(self) -> dict:
+        """Re-read the log file and CRC-check every record AT REST — the
+        scrubber's detection pass for silent bit-rot. In-memory state is
+        unaffected by disk rot (records were applied when written), so a
+        failure here means a future restart would lose the suffix, not
+        that serving is wrong. Returns {"ok", "header_ok", "valid_end",
+        "end"}: `valid_end < end` marks the first rotten byte's record
+        (safe to trust because `write()` flushes whole records under the
+        lock — the at-rest file is always record-complete up to `end`)."""
+        with self._lock:
+            self._f.flush()
+            base, end = self.base, self.base + self._size
+            with open(self.path, "rb") as f:
+                blob = f.read()
+        header_ok = len(blob) >= _HEADER.size
+        if header_ok:
+            magic, hdr_base = _HEADER.unpack_from(blob, 0)
+            header_ok = magic == MAGIC and int(hdr_base) == base
+        if not header_ok:
+            return {"ok": end == base, "header_ok": False,
+                    "valid_end": base, "end": end}
+        _, valid = parse_records(blob[_HEADER.size:_HEADER.size
+                                      + (end - base)], base)
+        return {"ok": valid >= end, "header_ok": True,
+                "valid_end": int(valid), "end": int(end)}
+
+    def splice(self, from_logical: int, to_logical: int, data: bytes) -> None:
+        """Overwrite the byte range [from_logical, to_logical) with
+        `data` (same length, record-validated by the caller) and rewrite
+        the file atomically — the at-rest bit-rot REPAIR path. The
+        replacement restores bytes only: the records were applied to
+        memory when first written, so no replay happens here. Peer bytes
+        are safe verbatim because replica logs are byte-interchangeable
+        (`append_raw`). Both locks are held across the swap, so racing
+        appends land after the preserved suffix and nothing is lost."""
+        if len(data) != to_logical - from_logical:
+            raise ValueError(
+                f"splice data is {len(data)}B for a "
+                f"{to_logical - from_logical}B range"
+            )
+        with self._sync_lock, self._lock:
+            if (
+                from_logical < self.base
+                or to_logical > self.base + self._size
+                or from_logical > to_logical
+            ):
+                raise ValueError(
+                    f"splice range [{from_logical}, {to_logical}) outside"
+                    f" log [{self.base}, {self.base + self._size})"
+                )
+            self._f.flush()
+            with open(self.path, "rb") as f:
+                f.seek(_HEADER.size)
+                blob = f.read(self._size)
+            tmp = self.path + ".splice"
+            with open(tmp, "wb") as f:
+                f.write(_HEADER.pack(MAGIC, self.base))
+                f.write(blob[: from_logical - self.base])
+                f.write(data)
+                f.write(blob[to_logical - self.base:])
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "ab")
+
     def close(self) -> None:
         with self._sync_lock, self._lock:
             try:
@@ -433,6 +501,77 @@ def truncate_torn_tail(path: str) -> int:
 # snapshots
 # ---------------------------------------------------------------------------
 
+# Per-file crc32 manifest inside each snapshot dir (PR 15): written
+# BEFORE snapshot.json so the commit marker still lands last, covering
+# every data file — what the scrubber and the backup archiver verify
+# against to catch at-rest bit-rot. Pre-manifest snapshots (older
+# clusters) are unverifiable, never quarantined.
+CRC_FILE = "crc.json"
+CORRUPT_SUFFIX = ".corrupt"
+
+
+def _crc_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            crc = zlib.crc32(b, crc)
+    return crc & 0xFFFFFFFF
+
+
+def is_committed_snapshot_name(name: str) -> bool:
+    """True for a committed `snap_<epoch>` dir name — excludes `.tmp`
+    aborts AND `.corrupt` quarantines (both still carry the prefix)."""
+    return name.startswith(SNAP_PREFIX) and name[len(SNAP_PREFIX):].isdigit()
+
+
+def quarantine_artifact(path: str) -> str | None:
+    """Rename a corrupt artifact out of the active set — NEVER delete it
+    (forensics; rot is evidence). Returns the quarantine path, unique-
+    suffixed when an earlier quarantine of the same name exists."""
+    if not os.path.exists(path):
+        return None
+    dst = path + CORRUPT_SUFFIX
+    n = 1
+    while os.path.exists(dst):
+        dst = f"{path}{CORRUPT_SUFFIX}.{n}"
+        n += 1
+    os.rename(path, dst)
+    return dst
+
+
+def verify_snapshot(snap_dir: str) -> list[str] | None:
+    """At-rest integrity of one committed snapshot dir: every file in
+    its crc.json manifest re-hashed. Returns the list of damaged file
+    names ([] = clean), or None when the dir predates crc manifests
+    (unverifiable — old but not provably corrupt)."""
+    try:
+        with open(os.path.join(snap_dir, "snapshot.json")) as f:
+            json.load(f)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return ["snapshot.json"]
+    crc_path = os.path.join(snap_dir, CRC_FILE)
+    if not os.path.exists(crc_path):
+        return None
+    try:
+        with open(crc_path) as f:
+            manifest = json.load(f)["files"]
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return [CRC_FILE]
+    bad = []
+    for name in sorted(manifest):
+        p = os.path.join(snap_dir, name)
+        try:
+            got = _crc_file(p)
+        except OSError:
+            bad.append(name)
+            continue
+        if got != int(manifest[name]):
+            bad.append(name)
+    return bad
+
 
 def _applied_blob(applied: "collections.OrderedDict") -> bytearray:
     """Serialize the applied-key window with the wire encoding: mutation
@@ -473,10 +612,25 @@ def write_snapshot(
     tmp = final + ".tmp"
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
-    # arrays may be memmaps of the source files; materialize on write
-    tformat.write_arrays(tmp, {k: np.asarray(v) for k, v in arrays.items()})
+    # arrays may be memmaps of the source files; materialize on write.
+    # fsync: the rename below is the commit point, so every byte must be
+    # on disk first — this is also what makes a replica bootstrap's
+    # install_snapshot durable BEFORE the ship is acknowledged.
+    tformat.write_arrays(
+        tmp, {k: np.asarray(v) for k, v in arrays.items()}, fsync=True
+    )
     with open(os.path.join(tmp, "applied.bin"), "wb") as f:
         f.write(_applied_blob(applied))
+        f.flush()
+        os.fsync(f.fileno())
+    # per-file crc manifest for the at-rest scrubber, before the commit
+    # marker: a dir whose snapshot.json exists always has its manifest
+    crcs = {
+        name: _crc_file(os.path.join(tmp, name))
+        for name in sorted(os.listdir(tmp))
+    }
+    with open(os.path.join(tmp, CRC_FILE), "w") as f:
+        json.dump({"version": 1, "files": crcs}, f)
         f.flush()
         os.fsync(f.fileno())
     meta = {"version": 1, "epoch": int(epoch), "wal_pos": int(wal_pos),
@@ -488,10 +642,16 @@ def write_snapshot(
     if os.path.exists(final):
         shutil.rmtree(final)
     os.replace(tmp, final)
-    # keep the newest two committed snapshots (fallback), reap the rest
+    dfd = os.open(wal_dir, os.O_RDONLY)
+    try:
+        os.fsync(dfd)  # the rename itself must survive power loss
+    finally:
+        os.close(dfd)
+    # keep the newest two committed snapshots (fallback), reap the rest;
+    # quarantined `.corrupt` dirs never count against the retained-good
+    # budget and are never reaped (evidence)
     snaps = sorted(
-        n for n in os.listdir(wal_dir)
-        if n.startswith(SNAP_PREFIX) and not n.endswith(".tmp")
+        n for n in os.listdir(wal_dir) if is_committed_snapshot_name(n)
     )
     for name in snaps[:-2]:
         shutil.rmtree(os.path.join(wal_dir, name), ignore_errors=True)
@@ -501,16 +661,27 @@ def write_snapshot(
     return final
 
 
-def load_snapshot(wal_dir: str, min_wal_pos: int = 0):
+def load_snapshot(
+    wal_dir: str,
+    min_wal_pos: int = 0,
+    quarantine: bool = False,
+    report: dict | None = None,
+):
     """Newest VALID snapshot as (epoch, arrays, applied, wal_pos), or
     None. Snapshots whose `wal_pos` predates `min_wal_pos` (the WAL's
     base — their replay suffix was already trimmed away) are unusable
-    and skipped; a corrupt newest snapshot falls back to the previous."""
+    and skipped; a corrupt newest snapshot falls back to the previous.
+
+    `quarantine=True` (recovery's mode) renames a corrupt dir to
+    `snap_<epoch>.corrupt` instead of leaving it in place — otherwise
+    the keep-2 GC counts the corpse against the retained-GOOD budget and
+    can reap the only loadable fallback. Read-only callers (snapshot
+    shipping) keep the default and never mutate the dir. `report`, when
+    given, collects the quarantined names under "snapshots_quarantined"."""
     if not os.path.isdir(wal_dir):
         return None
     snaps = sorted(
-        (n for n in os.listdir(wal_dir)
-         if n.startswith(SNAP_PREFIX) and not n.endswith(".tmp")),
+        (n for n in os.listdir(wal_dir) if is_committed_snapshot_name(n)),
         reverse=True,
     )
     for name in snaps:
@@ -525,7 +696,14 @@ def load_snapshot(wal_dir: str, min_wal_pos: int = 0):
                 applied = _applied_from_blob(f.read())
             return int(meta["epoch"]), arrays, applied, int(meta["wal_pos"])
         except (OSError, ValueError, KeyError, json.JSONDecodeError):
-            continue  # aborted/corrupt snapshot: fall back to an older one
+            # aborted/corrupt snapshot: fall back to an older one
+            if quarantine:
+                q = quarantine_artifact(d)
+                if report is not None and q is not None:
+                    report.setdefault("snapshots_quarantined", []).append(
+                        os.path.basename(q)
+                    )
+            continue
     return None
 
 
@@ -590,7 +768,9 @@ def recover(
     path = os.path.join(wal_dir, WAL_FILE)
     torn = truncate_torn_tail(path)
     records, base, _ = scan(path)
-    snap = load_snapshot(wal_dir, min_wal_pos=base)
+    quar: dict = {}
+    snap = load_snapshot(wal_dir, min_wal_pos=base, quarantine=True,
+                         report=quar)
     applied: collections.OrderedDict = collections.OrderedDict()
     if snap is None:
         if base > 0:
@@ -655,6 +835,7 @@ def recover(
         "records_replayed": replayed,
         "publishes_replayed": publishes,
         "torn_bytes_dropped": int(torn),
+        "snapshots_quarantined": quar.get("snapshots_quarantined", []),
         "recovery_ms": round((time.perf_counter() - t0) * 1e3, 3),
         "graph_epoch": int(getattr(store, "graph_epoch", 0)),
         "pending_rows": 0 if delta is None else delta.pending()["rows"],

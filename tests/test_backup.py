@@ -1,0 +1,814 @@
+"""Disaster-proof graph clusters (ISSUE 15).
+
+Epoch-consistent cluster backup (`backup_cluster`), point-in-time
+restore (`restore_cluster --epoch E` replaying the archived WAL suffix
+through the normal `recover()` path), and the background integrity
+scrubber (`scrub_service` / `IntegrityScrubber`): CRC re-verification
+of at-rest snapshots and WAL segments, quarantine of corrupt artifacts
+(`*.corrupt`, never silently deleted), repair from a live replica-group
+peer over the PR-13 `install_snapshot`/`wal_ship` verbs, and the
+degraded verdict when no peer can help. Every restore is pinned against
+a from-scratch `build_from_json` oracle; the chaos test flips bytes in
+a follower's snapshot AND WAL under live writer+reader traffic and
+proves peer repair with zero typed-error leaks.
+"""
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.distributed import connect
+from euler_tpu.distributed.service import GraphService
+from euler_tpu.distributed.writer import GraphWriter
+from euler_tpu.graph import Graph
+from euler_tpu.graph import backup as bk
+from euler_tpu.graph import wal as walmod
+from euler_tpu.graph.builder import build_from_json
+
+from test_replication import (  # noqa: F401  (patient_client is a fixture)
+    _assert_bit_identical,
+    _boot_group,
+    _muts,
+    _wait_converged,
+    _wait_single_primary,
+    patient_client,
+)
+from test_supervisor import _apply_json, _graph_dict, _route
+
+
+# -- helpers -------------------------------------------------------------
+
+
+def _dispatch_muts(svcs, muts, prefix):
+    """Route ("un"/"ue"/"de") mutations to in-process services with the
+    writer's owner split (out-edges by src%P, in-edges by dst%P) — the
+    same cols `GraphWriter._stage_outbox` would send."""
+    P = len(svcs)
+    eu = np.empty(0, np.uint64)
+    ei = np.empty(0, np.int32)
+    ef = np.empty(0, np.float32)
+    for i, m in enumerate(muts):
+        if m[0] == "un":
+            _, nid, t, w, feats = m
+            names = sorted(feats)
+            block = (
+                np.concatenate(
+                    [
+                        np.asarray(feats[nm], np.float32).reshape(1, -1)
+                        for nm in names
+                    ],
+                    axis=1,
+                )
+                if names
+                else None
+            )
+            svcs[nid % P].dispatch("upsert_nodes", [
+                f"{prefix}:{i}",
+                np.asarray([nid], np.uint64), np.asarray([t], np.int32),
+                np.asarray([w], np.float32), names, block,
+            ])
+        elif m[0] == "ue":
+            _, s, d, t, w = m
+            cols = (
+                np.asarray([s], np.uint64), np.asarray([d], np.uint64),
+                np.asarray([t], np.int32), np.asarray([w], np.float32),
+            )
+            for p in range(P):
+                out, inn = s % P == p, d % P == p
+                if not (out or inn):
+                    continue
+                a = [f"{prefix}:{i}:{p}"]
+                a += list(cols) if out else [eu, eu, ei, ef]
+                a += list(cols) if inn else [eu, eu, ei, ef]
+                svcs[p].dispatch("upsert_edges", a)
+        elif m[0] == "de":
+            _, s, d, t = m
+            cols = (
+                np.asarray([s], np.uint64), np.asarray([d], np.uint64),
+                np.asarray([t], np.int32),
+            )
+            for p in range(P):
+                out, inn = s % P == p, d % P == p
+                if not (out or inn):
+                    continue
+                a = [f"{prefix}:{i}:{p}"]
+                a += list(cols) if out else [eu, eu, ei]
+                a += list(cols) if inn else [eu, eu, ei]
+                svcs[p].dispatch("delete_edges", a)
+
+
+def _publish_all(svcs, key):
+    for p, svc in enumerate(svcs):
+        svc.dispatch("publish_epoch", [f"{key}:{p}"])
+
+
+def _rounds(n_rounds, k=3):
+    """Deterministic mutation rounds; each round touches every shard of
+    a 2-way split (odd+even endpoints) so per-shard epochs stay in
+    lockstep with the round number."""
+    out = []
+    for r in range(n_rounds):
+        rng = np.random.default_rng(100 + r)
+        muts = [
+            ("ue", int(rng.integers(1, 25)), int(rng.integers(1, 25)),
+             0, float(1 + r + j))
+            for j in range(k)
+        ]
+        muts.append(("ue", 2 * r + 1, 2 * r + 2, 0, float(10 + r)))
+        muts.append(("ue", 2 * r + 2, 2 * r + 3, 0, float(20 + r)))
+        out.append(muts)
+    return out
+
+
+def _recover_restored(base, parts, out_root, replication=1):
+    """Recover every shard of a restored wal-root against a from-scratch
+    base build — what a booting cluster does."""
+    g = Graph.from_json(base, num_partitions=parts)
+    stores = []
+    recs = []
+    for p in range(parts):
+        d = os.path.join(out_root, f"shard_{p}")
+        if replication > 1:
+            d = os.path.join(d, "replica_0")
+        rec = walmod.recover(g.meta, p, d, g.shards[p])
+        stores.append(rec.store)
+        recs.append(rec)
+    return g.meta, stores, recs
+
+
+def _flip_byte(path, offset):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b0 = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b0[0] ^ 0xFF]))
+
+
+# -- backup + restore: at-head and point-in-time -------------------------
+
+
+def test_backup_restore_at_head_one_shard(tmp_path, monkeypatch):
+    """At-head restore of a snapshotted shard is bit-identical to the
+    live pre-disaster state — published arrays AND the acked-but-
+    unpublished staged suffix both survive the archive round trip."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=1)
+    wal_root = str(tmp_path / "wal")
+    svc = GraphService(
+        g.shards[0], g.meta, 0,
+        wal_dir=os.path.join(wal_root, "shard_0"),
+    )
+    try:
+        rounds = _rounds(3)
+        _dispatch_muts([svc], rounds[0], "r0")
+        _publish_all([svc], "pub0")
+        assert svc.snapshot_now()  # trims: archive rides the snapshot
+        _dispatch_muts([svc], rounds[1], "r1")
+        _publish_all([svc], "pub1")
+        # an acked suffix the disaster must not lose — staged, invisible
+        _dispatch_muts([svc], rounds[2], "r2")
+
+        arch = str(tmp_path / "arch")
+        man = bk.backup_cluster(bk.collect_shard_dirs(wal_root), arch)
+        assert man["shards"]["0"]["epoch"] == 2
+        assert man["shards"]["0"]["snapshots"]  # anchored on the snapshot
+        assert bk.verify_archive(arch)["ok"]
+        # an archive dir is immutable: a second backup refuses to clobber
+        with pytest.raises(FileExistsError):
+            bk.backup_cluster(bk.collect_shard_dirs(wal_root), arch)
+
+        out = str(tmp_path / "restored")
+        rep = bk.restore_cluster(arch, out)
+        assert rep["shards"][0]["epoch"] == 2
+        _, stores, recs = _recover_restored(base, 1, out)
+        _, ref = build_from_json(
+            _apply_json(base, rounds[0] + rounds[1]), 1
+        )
+        _assert_bit_identical(
+            [type("S", (), {"store": stores[0]})()], ref[0]
+        )
+        assert stores[0].graph_epoch == 2
+        # the staged suffix came back: publishing it on both sides gives
+        # the same next epoch bit-for-bit
+        assert recs[0].report["pending_rows"] > 0
+        _publish_all([svc], "pubfinal")
+        merged, _rows, _ids = stores[0].merge_delta(recs[0].delta)
+        assert merged.graph_epoch == svc.store.graph_epoch == 3
+        for k in svc.store.arrays:
+            assert np.array_equal(
+                np.asarray(merged.arrays[k]),
+                np.asarray(svc.store.arrays[k]),
+            ), k
+        # restore refuses to clobber an existing wal dir
+        with pytest.raises(FileExistsError):
+            bk.restore_cluster(arch, out)
+        # the snapshot trim bounds the horizon: epoch 0 predates it
+        with pytest.raises(ValueError, match="predates"):
+            bk.restore_cluster(arch, str(tmp_path / "r0"), epoch=0)
+        # and epochs past the head are not in the archive either
+        with pytest.raises(ValueError, match="horizon"):
+            bk.restore_cluster(arch, str(tmp_path / "r9"), epoch=9)
+    finally:
+        svc.stop()
+
+
+def test_point_in_time_restore_every_epoch(tmp_path, monkeypatch):
+    """PITR sweep: with the full WAL horizon archived, `--epoch E`
+    reproduces EVERY historical epoch bit-identically to a from-scratch
+    build of exactly the mutations published through E — including the
+    fat-finger row (restore to final-1 discards only the last publish)."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=1)
+    wal_root = str(tmp_path / "wal")
+    svc = GraphService(
+        g.shards[0], g.meta, 0,
+        wal_dir=os.path.join(wal_root, "shard_0"),
+    )
+    try:
+        rounds = _rounds(4)
+        for r, muts in enumerate(rounds):
+            _dispatch_muts([svc], muts, f"r{r}")
+            _publish_all([svc], f"pub{r}")
+        arch = str(tmp_path / "arch")
+        man = bk.backup_cluster(bk.collect_shard_dirs(wal_root), arch)
+        assert man["shards"]["0"]["earliest_epoch"] == 0
+        assert man["shards"]["0"]["epoch"] == 4
+        for target in range(0, 5):
+            out = str(tmp_path / f"restored_e{target}")
+            rep = bk.restore_cluster(arch, out, epoch=target)
+            assert rep["shards"][0]["epoch"] == target
+            _, stores, _ = _recover_restored(base, 1, out)
+            assert stores[0].graph_epoch == target
+            flat = [m for ms in rounds[:target] for m in ms]
+            _, ref = build_from_json(_apply_json(base, flat), 1)
+            _assert_bit_identical(
+                [type("S", (), {"store": stores[0]})()], ref[0]
+            )
+    finally:
+        svc.stop()
+
+
+def test_backup_restore_two_shard_cluster(tmp_path, monkeypatch):
+    """2-shard cluster with MIXED anchors (shard 0 restarts from a
+    trimmed snapshot, shard 1 from source): at-head and --epoch E
+    restores are both bit-identical to the from-scratch oracle."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=2)
+    wal_root = str(tmp_path / "wal")
+    svcs = [
+        GraphService(
+            g.shards[p], g.meta, p,
+            wal_dir=os.path.join(wal_root, f"shard_{p}"),
+        )
+        for p in range(2)
+    ]
+    try:
+        rounds = _rounds(3)
+        _dispatch_muts(svcs, rounds[0], "r0")
+        _publish_all(svcs, "pub0")
+        assert svcs[0].snapshot_now()  # shard 0 only: mixed anchors
+        for r in (1, 2):
+            _dispatch_muts(svcs, rounds[r], f"r{r}")
+            _publish_all(svcs, f"pub{r}")
+
+        arch = str(tmp_path / "arch")
+        man = bk.backup_cluster(bk.collect_shard_dirs(wal_root), arch)
+        assert set(man["shards"]) == {"0", "1"}
+        assert man["shards"]["0"]["earliest_epoch"] == 1  # trimmed
+        assert man["shards"]["1"]["earliest_epoch"] == 0  # full horizon
+        assert bk.verify_archive(arch)["ok"]
+
+        # at head: every shard at epoch 3, bit-identical to the oracle
+        out = str(tmp_path / "restored_head")
+        bk.restore_cluster(arch, out)
+        _, stores, _ = _recover_restored(base, 2, out)
+        flat = [m for ms in rounds for m in ms]
+        _, ref = build_from_json(_apply_json(base, flat), 2)
+        for p in range(2):
+            assert stores[p].graph_epoch == 3
+            _assert_bit_identical(
+                [type("S", (), {"store": stores[p]})()], ref[p]
+            )
+
+        # point-in-time: epoch 2 (past shard 0's snapshot anchor, so the
+        # archived WAL suffix replays on top of it)
+        out2 = str(tmp_path / "restored_e2")
+        bk.restore_cluster(arch, out2, epoch=2)
+        _, stores2, _ = _recover_restored(base, 2, out2)
+        flat2 = [m for ms in rounds[:2] for m in ms]
+        _, ref2 = build_from_json(_apply_json(base, flat2), 2)
+        for p in range(2):
+            assert stores2[p].graph_epoch == 2
+            _assert_bit_identical(
+                [type("S", (), {"store": stores2[p]})()], ref2[p]
+            )
+
+        # replication>1 materializes replica dirs that each recover
+        out3 = str(tmp_path / "restored_r2")
+        rep3 = bk.restore_cluster(arch, out3, replication=2)
+        assert all(
+            len(s["dests"]) == 2 for s in rep3["shards"].values()
+        )
+        _, stores3, _ = _recover_restored(base, 2, out3, replication=2)
+        for p in range(2):
+            _assert_bit_identical(
+                [type("S", (), {"store": stores3[p]})()], ref[p]
+            )
+    finally:
+        for s in svcs:
+            s.stop()
+
+
+def test_archive_verify_detects_any_flip(tmp_path, monkeypatch):
+    """Cold-archive integrity: flipping one byte of ANY archived file
+    (WAL slice, snapshot tensor, manifest-tracked metadata) fails
+    `verify_archive`, and `restore_cluster` refuses the archive."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=1)
+    wal_root = str(tmp_path / "wal")
+    svc = GraphService(
+        g.shards[0], g.meta, 0,
+        wal_dir=os.path.join(wal_root, "shard_0"),
+    )
+    try:
+        _dispatch_muts([svc], _rounds(1)[0], "r0")
+        _publish_all([svc], "pub0")
+        assert svc.snapshot_now()
+        _dispatch_muts([svc], _rounds(2)[1], "r1")
+        _publish_all([svc], "pub1")
+        arch = str(tmp_path / "arch")
+        bk.backup_cluster(bk.collect_shard_dirs(wal_root), arch)
+
+        victims = []
+        for root, _dirs, files in os.walk(arch):
+            for fn in files:
+                if fn != bk.ARCHIVE_MANIFEST:
+                    victims.append(os.path.join(root, fn))
+        assert len(victims) >= 3  # wal slice + snapshot tensors + meta
+        for v in victims:
+            bad = str(tmp_path / "bad")
+            shutil.copytree(arch, bad)
+            _flip_byte(os.path.join(bad, os.path.relpath(v, arch)), 2)
+            res = bk.verify_archive(bad)
+            assert not res["ok"], os.path.relpath(v, arch)
+            assert res["bad_files"]
+            with pytest.raises(ValueError, match="failed verification"):
+                bk.restore_cluster(bad, str(tmp_path / "never"))
+            shutil.rmtree(bad)
+    finally:
+        svc.stop()
+
+
+def test_trainer_checkpoint_rides_the_archive(tmp_path, monkeypatch):
+    """The newest COMMIT-complete trainer checkpoint is archived (the
+    torn newer one is NOT) and restores bit-identically."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=1)
+    wal_root = str(tmp_path / "wal")
+    svc = GraphService(
+        g.shards[0], g.meta, 0,
+        wal_dir=os.path.join(wal_root, "shard_0"),
+    )
+    model = tmp_path / "model"
+    good = model / "ckpt_000000000004"
+    good.mkdir(parents=True)
+    payload = os.urandom(512)
+    (good / "weights.bin").write_bytes(payload)
+    (good / "COMMIT").write_text("{}")
+    torn = model / "ckpt_000000000005"  # newer but no COMMIT marker: ignored
+    torn.mkdir()
+    (torn / "weights.bin").write_bytes(b"half-written")
+    try:
+        _dispatch_muts([svc], _rounds(1)[0], "r0")
+        _publish_all([svc], "pub0")
+        arch = str(tmp_path / "arch")
+        man = bk.backup_cluster(
+            bk.collect_shard_dirs(wal_root), arch, model_dir=str(model)
+        )
+        assert man["trainer"]["checkpoint"] == "ckpt_000000000004"
+        assert bk.verify_archive(arch)["ok"]
+        out_model = tmp_path / "model2"
+        rep = bk.restore_cluster(
+            arch, str(tmp_path / "restored"), model_dir=str(out_model)
+        )
+        assert rep["trainer"]["checkpoint"] == "ckpt_000000000004"
+        got = (out_model / "ckpt_000000000004" / "weights.bin").read_bytes()
+        assert got == payload
+        assert (out_model / "ckpt_000000000004" / "COMMIT").exists()
+    finally:
+        svc.stop()
+
+
+# -- integrity scrubber --------------------------------------------------
+
+
+def test_scrub_solo_quarantines_and_degrades(tmp_path, monkeypatch):
+    """Solo shard, no peer: the scrubber detects at-rest rot in both the
+    snapshot and the WAL, quarantines to `*.corrupt` (never deletes),
+    repairs the snapshot locally from published state, marks the shard
+    degraded for the unrepairable WAL suffix — and reads keep serving
+    with zero typed-error leaks."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=1)
+    wal_dir = str(tmp_path / "wal" / "shard_0")
+    svc = GraphService(g.shards[0], g.meta, 0, wal_dir=wal_dir).start()
+    try:
+        _dispatch_muts([svc], _rounds(1)[0], "r0")
+        _publish_all([svc], "pub0")
+        assert svc.snapshot_now()
+        _dispatch_muts([svc], _rounds(2)[1], "r1")
+        _publish_all([svc], "pub1")
+
+        clean = svc.scrub_now()
+        assert clean["corruptions"] == [] and clean["degraded"] is None
+        assert clean["snapshots_checked"] == 1
+        assert clean["wal_bytes_checked"] > 0
+
+        # an acked-but-unpublished suffix: its WAL bytes sit PAST the
+        # last publish position, so the local re-snapshot repair (which
+        # trims through the publish point) cannot paper over rot here
+        _dispatch_muts([svc], _rounds(3)[2], "suffix")
+        snaps = [
+            n for n in sorted(os.listdir(wal_dir))
+            if walmod.is_committed_snapshot_name(n)
+        ]
+        _flip_byte(os.path.join(wal_dir, snaps[-1], "tensors.bin"), 7)
+        wal_path = os.path.join(wal_dir, walmod.WAL_FILE)
+        _flip_byte(wal_path, os.path.getsize(wal_path) - 9)
+
+        rep = svc.scrub_now()
+        arts = sorted(c["artifact"] for c in rep["corruptions"])
+        assert len(arts) == 2 and arts[1] == walmod.WAL_FILE
+        # snapshot: quarantined + re-written from the published store
+        assert any(
+            r["via"] == "local_resnapshot" for r in rep["repairs"]
+        )
+        corrupts = [
+            n for n in os.listdir(wal_dir)
+            if walmod.CORRUPT_SUFFIX in n
+        ]
+        assert corrupts  # quarantined, not deleted
+        fresh = [
+            n for n in os.listdir(wal_dir)
+            if walmod.is_committed_snapshot_name(n)
+        ]
+        assert fresh
+        assert walmod.verify_snapshot(
+            os.path.join(wal_dir, fresh[-1])
+        ) == []
+        # WAL: no peer to refetch the suffix from → degraded, loudly
+        assert rep["degraded"] and "no peer" in rep["degraded"]
+
+        # telemetry: counters ride `stats` and `repl_status`
+        st = json.loads(svc.dispatch("stats", [])[0])
+        assert st["scrub_passes"] == 2
+        assert st["scrub_corruptions"] == 2
+        assert st["scrub_repairs"] == 1
+        assert "no peer" in st["degraded"]
+        rs = svc.repl_status()
+        assert rs["scrub_corruptions"] == 2 and rs["degraded"]
+
+        # never silently serves corrupt bytes: reads still answer from
+        # the intact in-memory store, no typed-error leak
+        nn = svc.dispatch("num_nodes", [])
+        assert int(nn[0]) >= len(base["nodes"])
+    finally:
+        svc.stop()
+
+
+def test_scrub_wire_verb_and_background_thread(tmp_path, monkeypatch):
+    """`scrub` is a wire verb (`scrub_remote` → report JSON), and a
+    service started with EULER_TPU_SCRUB_S > 0 runs passes on its own."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    monkeypatch.setenv("EULER_TPU_SCRUB_S", "0.05")
+    base = _graph_dict()
+    g = Graph.from_json(base, num_partitions=1)
+    svc = GraphService(
+        g.shards[0], g.meta, 0,
+        wal_dir=str(tmp_path / "wal" / "shard_0"),
+    ).start()
+    try:
+        assert svc._scrubber is not None
+        _dispatch_muts([svc], _rounds(1)[0], "r0")
+        _publish_all([svc], "pub0")
+        rep = bk.scrub_remote(svc.host, svc.port)
+        assert rep["shard"] == 0 and rep["corruptions"] == []
+        deadline = time.monotonic() + 10.0
+        while svc.scrub_passes < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert svc.scrub_passes >= 3  # the background cadence is live
+    finally:
+        svc.stop()
+
+
+def test_scrub_repairs_follower_from_primary_under_live_traffic(
+    tmp_path, patient_client, monkeypatch
+):
+    """Chaos acceptance (ISSUE 15): seeded byte-flips in a FOLLOWER's
+    at-rest snapshot AND WAL while a writer streams mutations and a
+    reader polls the follower. The scrubber detects both, repairs the
+    WAL suffix from the primary over `wal_ship`, re-commits a clean
+    snapshot, leaks no typed errors to the reader, and the repaired
+    replica ends bit-identical to the from-scratch oracle."""
+    # no auto-snapshot cadence: a mid-test trim would silently discard
+    # the seeded WAL rot instead of letting the scrubber find it
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    base, d, regdir, svcs = _boot_group(tmp_path, group_size=2)
+    g = None
+    stop = threading.Event()
+    reader_errs: list = []
+    writer_errs: list = []
+    acked: list = []
+    try:
+        pri = _wait_single_primary(svcs)
+        fol = next(s for s in svcs if s is not pri)
+        g = connect(registry_path=regdir, num_shards=1)
+        w = GraphWriter(g)
+        first = _muts(seed=31)
+        _route(w, first)
+        w.flush()
+        w.publish()
+        acked.extend(first)
+        _wait_converged(svcs, pri)
+        assert fol.snapshot_now()  # at-rest artifact to corrupt
+
+        def writer_loop():
+            try:
+                for i in range(40):
+                    if stop.is_set():
+                        break
+                    ms = _muts(seed=1000 + i, k=2)
+                    _route(w, ms)
+                    w.flush()
+                    w.publish()
+                    acked.extend(ms)
+                    time.sleep(0.01)
+            except Exception as e:  # noqa: BLE001
+                writer_errs.append(e)
+
+        def reader_loop():
+            while not stop.is_set():
+                try:
+                    st = json.loads(fol.dispatch("stats", [])[0])
+                    assert "graph_epoch" in st
+                    fol.dispatch("num_nodes", [])
+                except Exception as e:  # noqa: BLE001
+                    reader_errs.append(e)
+                time.sleep(0.005)
+
+        wt = threading.Thread(target=writer_loop)
+        rt = threading.Thread(target=reader_loop)
+        wt.start()
+        rt.start()
+        time.sleep(0.1)
+
+        # seeded disaster: flip a snapshot tensor byte and a WAL byte in
+        # the follower's durable prefix
+        snaps = [
+            n for n in sorted(os.listdir(fol.wal_dir))
+            if walmod.is_committed_snapshot_name(n)
+        ]
+        _flip_byte(
+            os.path.join(fol.wal_dir, snaps[-1], "tensors.bin"), 11
+        )
+        wal_path = fol._wal.path
+        with fol._wal._lock:
+            sz = os.path.getsize(wal_path)
+        _flip_byte(wal_path, max(walmod._HEADER.size + 1, sz - 37))
+
+        rep = fol.scrub_now()
+        arts = sorted(c["artifact"] for c in rep["corruptions"])
+        assert walmod.WAL_FILE in arts and len(arts) == 2
+        vias = [r["via"] for r in rep["repairs"]]
+        # the WAL suffix came back from the primary — either scrub won
+        # the race (targeted `wal_ship` splice) or the follower's own
+        # continuity handshake saw the rot first and re-bootstrapped
+        assert any(
+            v.startswith("peer ") or v == "replication bootstrap"
+            for v in vias
+        ), vias
+        assert rep["degraded"] is None
+        assert fol._wal.verify()["ok"]
+        # quarantined copies kept for forensics, never deleted
+        assert any(
+            walmod.CORRUPT_SUFFIX in n for n in os.listdir(fol.wal_dir)
+        )
+
+        stop.set()
+        wt.join(timeout=30)
+        rt.join(timeout=10)
+        assert not writer_errs
+        assert not reader_errs  # zero typed-error leaks during repair
+        w.publish()
+        w.close()
+        _wait_converged(svcs, pri)
+        merged = _apply_json(base, acked)
+        _, ref_shards = build_from_json(merged, 1)
+        _assert_bit_identical(svcs, ref_shards[0])
+        # fleet-visible counters on the repaired follower
+        rs = fol.repl_status()
+        assert rs["scrub_corruptions"] >= 2 and rs["scrub_repairs"] >= 1
+    finally:
+        stop.set()
+        if g is not None:
+            g.stop_topology_watch()
+        for s in svcs:
+            s.stop()
+
+
+def test_scrub_wal_splice_repair_from_peer(
+    tmp_path, patient_client, monkeypatch
+):
+    """Deterministic splice path: with the follower's tail loop
+    silenced (so the replication handshake cannot race the repair), the
+    scrubber re-fetches exactly the rotted byte range from the primary
+    over `wal_ship` and splices it in place — quarantining a copy of
+    the rotted file first and ending byte-identical to the primary."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")  # no trim races
+    base, d, regdir, svcs = _boot_group(tmp_path, group_size=2)
+    g = None
+    try:
+        pri = _wait_single_primary(svcs)
+        fol = next(s for s in svcs if s is not pri)
+        g = connect(registry_path=regdir, num_shards=1)
+        w = GraphWriter(g)
+        for i in range(4):
+            _route(w, _muts(seed=50 + i))
+            w.flush()
+            w.publish()
+        w.close()
+        _wait_converged(svcs, pri)
+        # silence the follower's coordinator: no ship polls, no
+        # handshake-triggered self-heal — the scrubber is on its own
+        fol._repl._stop.set()
+        time.sleep(0.1)
+        wal_path = fol._wal.path
+        sz = os.path.getsize(wal_path)
+        _flip_byte(wal_path, sz // 2)
+        v = fol._wal.verify()
+        assert not v["ok"]
+        rep = fol.scrub_now()
+        hit = [
+            r for r in rep["repairs"]
+            if r["artifact"] == walmod.WAL_FILE
+            and r["via"].startswith("peer ")
+        ]
+        assert hit and hit[0]["bytes"] > 0
+        assert hit[0]["quarantined_to"].startswith(walmod.WAL_FILE)
+        assert rep["degraded"] is None
+        assert fol._wal.verify()["ok"]
+        # bytes restored verbatim: both logs identical again
+        with open(wal_path, "rb") as f1, open(pri._wal.path, "rb") as f2:
+            assert f1.read() == f2.read()
+    finally:
+        if g is not None:
+            g.stop_topology_watch()
+        for s in svcs:
+            s.stop()
+
+
+def test_scrub_snapshot_repair_falls_back_to_peer(
+    tmp_path, patient_client
+):
+    """When local re-snapshot is impossible the scrubber pulls a full
+    snapshot from a live peer over `install_snapshot` — and only a
+    peerless shard ends degraded."""
+    base, d, regdir, svcs = _boot_group(tmp_path, group_size=2)
+    g = None
+    try:
+        pri = _wait_single_primary(svcs)
+        fol = next(s for s in svcs if s is not pri)
+        g = connect(registry_path=regdir, num_shards=1)
+        w = GraphWriter(g)
+        _route(w, _muts(seed=41))
+        w.flush()
+        w.publish()
+        w.close()
+        _wait_converged(svcs, pri)
+        assert fol.snapshot_now()
+        snaps = [
+            n for n in sorted(os.listdir(fol.wal_dir))
+            if walmod.is_committed_snapshot_name(n)
+        ]
+        _flip_byte(
+            os.path.join(fol.wal_dir, snaps[-1], "applied.bin"), 3
+        )
+        # simulate "nothing publishable in memory" (fresh boot mid-
+        # bootstrap) for the scrubber's FIRST local attempt only — the
+        # peer install's own persist step must still work
+        real_snapshot_now = fol.snapshot_now
+        calls = []
+
+        def flaky_snapshot_now():
+            if not calls:
+                calls.append(1)
+                return False
+            return real_snapshot_now()
+
+        fol.snapshot_now = flaky_snapshot_now
+        rep = fol.scrub_now()
+        assert any(
+            r["artifact"] == "snapshot" and r["via"].startswith("peer ")
+            for r in rep["repairs"]
+        ), rep["repairs"]
+        assert rep["degraded"] is None
+        # the peer-installed snapshot landed on disk and verifies clean
+        fresh = [
+            n for n in sorted(os.listdir(fol.wal_dir))
+            if walmod.is_committed_snapshot_name(n)
+        ]
+        assert fresh
+        assert walmod.verify_snapshot(
+            os.path.join(fol.wal_dir, fresh[-1])
+        ) == []
+        _assert_bit_identical([fol], pri.store.arrays)
+    finally:
+        if g is not None:
+            g.stop_topology_watch()
+        for s in svcs:
+            s.stop()
+
+
+# -- full-cluster loss ---------------------------------------------------
+
+
+def test_full_cluster_loss_backup_restore_resume(tmp_path, monkeypatch):
+    """The ISSUE-15 disaster drill: back up a live 2-shard cluster,
+    `rm -rf` every WAL dir, restore from the archive, boot fresh
+    services on the restored dirs, and keep writing — the resumed
+    cluster is bit-identical to a twin that never died."""
+    monkeypatch.setenv("EULER_TPU_SNAPSHOT_EVERY", "0")
+    base = _graph_dict()
+    rounds = _rounds(4)
+
+    def boot(wal_root):
+        g = Graph.from_json(base, num_partitions=2)
+        return [
+            GraphService(
+                g.shards[p], g.meta, p,
+                wal_dir=os.path.join(wal_root, f"shard_{p}"),
+            )
+            for p in range(2)
+        ]
+
+    wal_root = str(tmp_path / "wal")
+    twin_root = str(tmp_path / "wal_twin")
+    svcs = boot(wal_root)
+    twin = boot(twin_root)
+    try:
+        for r in (0, 1):
+            for cluster, tag in ((svcs, "c"), (twin, "t")):
+                _dispatch_muts(cluster, rounds[r], f"{tag}{r}")
+                _publish_all(cluster, f"{tag}pub{r}")
+        assert svcs[0].snapshot_now()
+
+        arch = str(tmp_path / "arch")
+        bk.backup_cluster(bk.collect_shard_dirs(wal_root), arch)
+
+        # total loss: processes die, every WAL dir is wiped
+        for s in svcs:
+            s.stop()
+        shutil.rmtree(wal_root)
+        assert not os.path.exists(wal_root)
+
+        # boot fresh services on the restored dirs — the service's own
+        # constructor recovery replays the restored WAL over the base
+        bk.restore_cluster(arch, wal_root)
+        svcs = boot(wal_root)
+        for p in range(2):
+            assert svcs[p].store.graph_epoch == 2  # back at the backup
+
+        # resumed traffic lands identically on both clusters
+        for r in (2, 3):
+            for cluster, tag in ((svcs, "c"), (twin, "t")):
+                _dispatch_muts(cluster, rounds[r], f"{tag}{r}")
+                _publish_all(cluster, f"{tag}pub{r}")
+        for p in range(2):
+            assert (
+                svcs[p].store.graph_epoch == twin[p].store.graph_epoch
+            )
+            _assert_bit_identical([svcs[p]], twin[p].store.arrays)
+        # and both equal the from-scratch oracle of every mutation
+        flat = [m for ms in rounds for m in ms]
+        _, ref = build_from_json(_apply_json(base, flat), 2)
+        for p in range(2):
+            _assert_bit_identical([svcs[p], twin[p]], ref[p])
+        # serving resumes: reads answer on the restored cluster
+        assert int(svcs[0].dispatch("num_nodes", [])[0]) > 0
+    finally:
+        for s in svcs + twin:
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001
+                pass

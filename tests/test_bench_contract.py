@@ -148,6 +148,18 @@ def test_bench_smoke_emits_final_json_line():
     assert row["analytics_incremental_speedup_x"] > 0
     # the incremental rerun must actually skip work, not just match bits
     assert 0 < row["analytics_rows_recomputed_ratio"] < 1, row
+    # the disaster-recovery lane (ISSUE 15) must not silently vanish:
+    # backup MB/s, total-loss restore-to-first-read latency, at-rest
+    # scrub MB/s, the worst-case scrub-vs-reader interference ratio,
+    # and the restored == archived bit-parity oracle all ride the
+    # artifact
+    assert row["dr"] is True, row
+    assert row["dr_bit_parity"] is True, row
+    assert row["dr_backup_mb_per_sec"] > 0
+    assert row["dr_archive_mb"] > 0
+    assert row["dr_restore_to_first_read_ms"] > 0
+    assert row["dr_scrub_mb_per_sec"] > 0
+    assert row["dr_read_rate_scrub_over_idle"] > 0
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline

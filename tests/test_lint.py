@@ -117,6 +117,11 @@ def test_gate_covers_the_package():
         # condition variables, and the WAL-shipping tail loop — lock-
         # discipline and wire-protocol territory
         "euler_tpu/distributed/replication.py",
+        # the disaster-recovery lane (ISSUE 15): archive commits must be
+        # durable-write clean, and the scrubber's peer repair rides the
+        # wire protocol — both checker territories
+        "euler_tpu/graph/backup.py",
+        "euler_tpu/tools/backup.py",
         "bench.py",
     ):
         assert must in rels, f"{must} escaped the lint gate"

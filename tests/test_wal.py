@@ -556,3 +556,104 @@ def test_writer_close_surfaces_typed_errors(durable_shard):
     assert w._closed  # sealed either way: no NEW batches pile in
     assert w.pending()["outbox_batches"] == 1  # ...but nothing was dropped
     assert w.flush() == 1  # retried flush (original key) still lands
+
+
+# ---------------------------------------------------------------------------
+# at-rest integrity primitives (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_crc_range_read_raw_property_sweep_across_trim(tmp_path):
+    """Property sweep of the repair/ship primitives across a trim()
+    boundary: for EVERY probe window (a, b) drawn around the trim point
+    and record boundaries, `crc_range` either matches the checksum of
+    the untrimmed reference bytes (window fully inside [base, end]) or
+    raises ValueError — never a silently wrong checksum. `read_raw`
+    serves exactly the reference suffix from any surviving boundary and
+    refuses trimmed history."""
+    import zlib
+
+    path = str(tmp_path / "wal.log")
+    log = walmod.WriteAheadLog(path)
+    want = _sample_records() + _sample_records()
+    ends = [log.append(op, vals) for op, vals in want]
+    end = ends[-1]
+    with open(path, "rb") as f:
+        ref = f.read()[walmod._HEADER.size:]  # logical-offset addressed
+    assert len(ref) == end
+
+    def ref_crc(a, b):
+        return zlib.crc32(ref[a:b]) & 0xFFFFFFFF
+
+    cut = ends[len(ends) // 2 - 1]
+    log.trim(cut)
+    bounds = sorted({0, *ends})
+    probes = sorted(
+        {p for b in bounds for p in (b - 1, b, b + 1) if 0 <= p <= end}
+        | {cut + 3, (cut + end) // 2}
+    )
+    for a in probes:
+        for b in probes:
+            if cut <= a <= b <= end:
+                assert log.crc_range(a, b) == ref_crc(a, b), (a, b)
+            else:
+                with pytest.raises(ValueError):
+                    log.crc_range(a, b)
+
+    live_bounds = [b for b in bounds if b >= cut]
+    for a in live_bounds:
+        blob, got_end = log.read_raw(a, 1 << 20)
+        assert got_end == end and blob == ref[a:end], a
+        _, valid_end = walmod.parse_records(blob, a)
+        assert valid_end == end
+    for a in [b for b in bounds if b < cut]:
+        with pytest.raises(ValueError):
+            log.read_raw(a, 1 << 20)
+    # max_bytes cuts at whole-record boundaries, first record ships whole
+    for cap in range(1, 260, 13):
+        blob, got_end = log.read_raw(cut, cap)
+        assert got_end == cut + len(blob)
+        assert got_end in set(live_bounds)
+        first = min(b for b in live_bounds if b > cut)
+        assert len(blob) <= cap or got_end == first
+    log.close()
+
+
+def test_archived_wal_slice_flip_at_every_offset_detected(tmp_path):
+    """The archived-WAL reader with its manifest checksum refuses a
+    byte flip at EVERY offset of the slice — header, base field, record
+    headers, and payloads alike — so a rotted archive can never restore
+    quietly. Magic-rot stays loud even without the checksum."""
+    import zlib
+
+    from euler_tpu.graph import backup as bk
+
+    path = str(tmp_path / "wal.log")
+    log = walmod.WriteAheadLog(path)
+    want = _sample_records()
+    ends = [log.append(op, vals) for op, vals in want]
+    log.close()
+    with open(path, "rb") as f:
+        blob = f.read()
+    crc = zlib.crc32(blob) & 0xFFFFFFFF
+    records, base, valid_end = bk.read_archive_wal(path, expect_crc=crc)
+    assert base == 0 and valid_end == ends[-1]
+    assert [op for op, _a, _e in records] == [op for op, _v in want]
+
+    with open(path, "r+b") as f:
+        for off in range(len(blob)):
+            f.seek(off)
+            f.write(bytes([blob[off] ^ 0xFF]))
+            f.flush()
+            with pytest.raises(ValueError):
+                bk.read_archive_wal(path, expect_crc=crc)
+            f.seek(off)
+            f.write(bytes([blob[off]]))
+    # intact again after the sweep
+    bk.read_archive_wal(path, expect_crc=crc)
+    # magic-field rot is structural — loud even without expect_crc
+    with open(path, "r+b") as f:
+        f.seek(0)
+        f.write(b"X")
+    with pytest.raises(ValueError):
+        bk.read_archive_wal(path)
