@@ -895,6 +895,247 @@ def _availability_lane(smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bytes_lane(smoke: bool) -> dict:
+    """Byte-path lane (ISSUE 16; EULER_BENCH_BYTES=0 opt-out): what the
+    compact encodings actually save on the artifact, A/B'd in one run —
+    dense wire bytes/batch f32 vs bf16 vs int8 (real client wire
+    counters), warm-cache resident bytes per dtype, neighbor planes raw
+    vs delta+varint, and replication catch-up MB/s / quorum acked-rows
+    overhead with the identity codec + lockstep shipping vs the default
+    compressed + pipelined path."""
+    import shutil
+    import tempfile
+
+    from euler_tpu.distributed.client import RemoteShard
+    from euler_tpu.distributed.registry import Registry
+    from euler_tpu.distributed.service import GraphService
+    from euler_tpu.graph import Graph
+
+    n, dim, ids_per, batches, rows_per = (
+        (64, 32, 48, 60, 64) if smoke else (2000, 64, 256, 150, 256)
+    )
+    # small ship batches force a multi-batch catch-up stream even at
+    # smoke sizing — that is the regime the pipelined path exists for
+    ship_max = 32768 if smoke else 262144
+    ttl = 1.0
+    rng = np.random.default_rng(16)
+    nodes = [
+        {"id": i + 1, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense",
+                       "value": rng.normal(size=dim).tolist()}]}
+        for i in range(n)
+    ]
+    edges = [
+        {"src": s, "dst": s % n + 1, "type": 0, "weight": 1.0,
+         "features": []}
+        for s in range(1, n + 1)
+    ]
+    data = {"nodes": nodes, "edges": edges}
+    tmp = tempfile.mkdtemp(prefix="etpu_bench_bytes_")
+    knobs = (
+        "EULER_TPU_PAGE_DTYPE", "EULER_TPU_WIRE_CODEC",
+        "EULER_TPU_SHIP_PIPELINE", "EULER_TPU_REPL_ACK",
+        "EULER_TPU_SHIP_MAX_BYTES",
+    )
+    saved = {k: os.environ.get(k) for k in knobs}
+    svcs = []
+
+    def reqs(tag):
+        r = np.random.default_rng(7)
+        out = []
+        for b in range(batches):
+            src = r.integers(1, n + 1, rows_per).astype(np.uint64)
+            dst = r.integers(1, n + 1, rows_per).astype(np.uint64)
+            out.append([
+                f"bytes:{tag}:{b}", src, dst,
+                np.zeros(rows_per, np.int32),
+                r.random(rows_per).astype(np.float32),
+                np.empty(0, np.uint64), np.empty(0, np.uint64),
+                np.empty(0, np.int32), np.empty(0, np.float32),
+            ])
+        return out
+
+    def acked_rows_per_sec(svc, tag):
+        rs = reqs(tag)
+        t0 = time.perf_counter()
+        for a in rs:
+            svc.dispatch("upsert_edges", a)
+        return batches * rows_per / (time.perf_counter() - t0)
+
+    def boot_member(sub, rid, mode, group_size=2):
+        os.environ["EULER_TPU_REPL_ACK"] = mode
+        g = Graph.from_json(data, num_partitions=1)
+        return GraphService(
+            g.shards[0], g.meta, 0,
+            registry=Registry(os.path.join(tmp, sub, "reg"), ttl=2.0),
+            wal_dir=os.path.join(tmp, sub, f"wal_r{rid}"),
+            replica=rid, group_size=group_size, lease_ttl=ttl,
+        ).start()
+
+    def wait_role(svc, role, timeout_s=20.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if svc.repl_status()["role"] == role:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"replica never became {role}")
+
+    try:
+        # -- dense wire + warm-cache A/B: one server, fresh client per
+        # page dtype so the sticky negotiation flag and cache reset
+        g = Graph.from_json(data, num_partitions=1)
+        read_svc = GraphService(g.shards[0], g.meta, 0).start()
+        svcs.append(read_svc)
+        ids = np.arange(1, ids_per + 1, dtype=np.uint64)
+
+        def dense_leg(kind):
+            os.environ["EULER_TPU_PAGE_DTYPE"] = kind
+            rs = RemoteShard(0, [(read_svc.host, read_svc.port)])
+            try:
+                a = rs.get_dense_feature(ids, ["feat"])
+                wire = int(rs.wire_bytes_in.get("get_dense_feature", 0))
+                rs.get_dense_feature(ids, ["feat"])  # warm: cache hit
+                rewire = (
+                    int(rs.wire_bytes_in.get("get_dense_feature", 0))
+                    - wire
+                )
+                resident = rs._cache.nbytes if rs._cache else 0
+            finally:
+                rs.close()
+            return np.asarray(a), wire, resident, rewire
+
+        f32_vals, f32_wire, f32_res, f32_rewire = dense_leg("f32")
+        bf_vals, bf_wire, bf_res, _ = dense_leg("bf16")
+        _, i8_wire, _, _ = dense_leg("int8")
+        os.environ.pop("EULER_TPU_PAGE_DTYPE", None)
+        bf_err = float(np.max(np.abs(bf_vals - f32_vals)))
+
+        # neighbor planes: identity codec (raw u64 wire) vs the default
+        # delta+varint offer — exact either way, bytes differ
+        def nb_leg(codec_name):
+            os.environ["EULER_TPU_WIRE_CODEC"] = codec_name
+            rs = RemoteShard(0, [(read_svc.host, read_svc.port)])
+            try:
+                rs.get_full_neighbor(ids, [0])
+                return int(rs.wire_bytes_in.get("get_full_neighbor", 0))
+            finally:
+                rs.close()
+
+        nb_raw = nb_leg("id")
+        nb_delta = nb_leg("zlib")
+
+        # -- replication A/B: identity + lockstep vs zlib + pipelined.
+        # Each leg measures quorum acked-rows/s (vs one solo baseline)
+        # and follower catch-up MB/s with a late-joining follower.
+        solo = GraphService(
+            Graph.from_json(data, num_partitions=1).shards[0],
+            Graph.from_json(data, num_partitions=1).meta, 0,
+            wal_dir=os.path.join(tmp, "solo_wal"),
+        )
+        svcs.append(solo)
+        solo_rate = acked_rows_per_sec(solo, "solo")
+
+        def finished(members):
+            for svc in members:
+                svcs.remove(svc)
+                try:
+                    svc.stop()
+                except OSError:
+                    pass
+
+        def catchup_once(sub):
+            # async primary writes a backlog alone (2x the quorum
+            # traffic so shipping dominates follower boot cost), then
+            # the follower joins late and streams it
+            pri_a = boot_member(sub, 0, "async")
+            svcs.append(pri_a)
+            wait_role(pri_a, "primary")
+            for tag in (f"w1{sub}", f"w2{sub}", f"w3{sub}", f"w4{sub}"):
+                acked_rows_per_sec(pri_a, tag)
+            shipped = pri_a._wal.tell()
+            t0 = time.perf_counter()
+            fol_a = boot_member(sub, 1, "async")
+            svcs.append(fol_a)
+            deadline = time.monotonic() + 60
+            while fol_a._wal.tell() < shipped:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("follower catch-up stalled")
+                time.sleep(0.0005)  # fine: the whole stream is ~50ms
+            mbps = shipped / 1e6 / max(time.perf_counter() - t0, 1e-9)
+            st = fol_a.repl_status()
+            finished([pri_a, fol_a])
+            return mbps, st
+
+        def quorum_once(sub):
+            pri_q = boot_member(sub, 0, "quorum")
+            fol_q = boot_member(sub, 1, "quorum")
+            svcs.extend([pri_q, fol_q])
+            wait_role(pri_q, "primary")
+            pri_q.dispatch("upsert_edges", reqs(f"warm{sub}")[0])
+            rate = acked_rows_per_sec(pri_q, sub)
+            finished([pri_q, fol_q])
+            return rate
+
+        def ship_leg(sub, codec_name, pipeline):
+            # best-of-N: single-run numbers at smoke sizing are noisy
+            # (fsync and scheduler variance swamp a ~50ms stream)
+            os.environ["EULER_TPU_WIRE_CODEC"] = codec_name
+            os.environ["EULER_TPU_SHIP_PIPELINE"] = pipeline
+            os.environ["EULER_TPU_SHIP_MAX_BYTES"] = str(ship_max)
+            q_rate = max(quorum_once(f"q{sub}{i}") for i in range(3))
+            mbps, st = max(
+                (catchup_once(f"a{sub}{i}") for i in range(4)),
+                key=lambda r: r[0],
+            )
+            return q_rate, mbps, st
+
+        id_rate, id_mbps, _ = ship_leg("id", "id", "0")
+        zl_rate, zl_mbps, zl_st = ship_leg("zl", "zlib", "1")
+        wire_ratio = zl_st["ship_bytes"] / max(
+            zl_st["ship_wire_bytes"], 1
+        )
+        return {
+            "bytes": True,
+            "bytes_dense_f32_per_batch": int(f32_wire),
+            "bytes_dense_bf16_per_batch": int(bf_wire),
+            "bytes_dense_int8_per_batch": int(i8_wire),
+            "bytes_dense_reduction_pct": round(
+                100.0 * (1 - bf_wire / max(f32_wire, 1)), 1
+            ),
+            "bytes_dense_bf16_max_err": round(bf_err, 6),
+            "bytes_warm_cache_f32": int(f32_res),
+            "bytes_warm_cache_bf16": int(bf_res),
+            "bytes_warm_cache_saved_pct": round(
+                100.0 * (1 - bf_res / max(f32_res, 1)), 1
+            ),
+            "bytes_warm_rewire": int(f32_rewire),  # 0 == cache held
+            "bytes_full_nb_raw": int(nb_raw),
+            "bytes_full_nb_delta": int(nb_delta),
+            "bytes_catchup_mb_per_sec_id": round(id_mbps, 2),
+            "bytes_catchup_mb_per_sec_zlib": round(zl_mbps, 2),
+            "bytes_quorum_overhead_x_id": round(
+                solo_rate / max(id_rate, 1e-9), 3
+            ),
+            "bytes_quorum_overhead_x_zlib": round(
+                solo_rate / max(zl_rate, 1e-9), 3
+            ),
+            "bytes_ship_compression_ratio": round(wire_ratio, 2),
+            "bytes_ship_pipelined_batches": int(zl_st["ship_pipelined"]),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for svc in svcs:
+            try:
+                svc.stop()
+            except OSError:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _resume_lane(smoke: bool) -> dict:
     """Durable-training lane (ISSUE 10; EULER_BENCH_RESUME=0 opt-out):
     checkpoint cost on the step path with the async writer vs inline
@@ -1456,6 +1697,16 @@ def run(platform: str) -> tuple[float, dict]:
 
             traceback.print_exc()
             extra.update({"dr": False, "dr_error": repr(e)[:300]})
+    # byte-path lane (ISSUE 16) — dense wire f32/bf16/int8 A/B, varint
+    # neighbor planes, compressed+pipelined catch-up vs identity lockstep
+    if os.environ.get("EULER_BENCH_BYTES", "1") != "0":
+        try:
+            extra.update(_bytes_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update({"bytes": False, "bytes_error": repr(e)[:300]})
     probe = _probe_meta()
     if probe:
         extra["probe"] = probe

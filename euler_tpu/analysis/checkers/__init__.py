@@ -2,6 +2,7 @@
 built-in checkers with euler_tpu.analysis.core.CHECKERS."""
 
 from euler_tpu.analysis.checkers import (  # noqa: F401
+    borrowed_buffer_escape,
     determinism,
     durable_write,
     jit_purity,

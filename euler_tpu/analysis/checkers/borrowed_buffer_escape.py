@@ -1,0 +1,256 @@
+"""borrowed-buffer-escape: borrow-mode decode results retained past the
+frame that backs them.
+
+Check id:
+  borrowed-buffer-escape — a value produced by a ``decode(...,
+                    borrow=True)`` call (or any alias/row/slice of one)
+                    is stored somewhere that outlives the call frame —
+                    a ``self.`` attribute, a subscript store into a
+                    ``self.`` container or module-global, or a
+                    retaining container method (``append``/``add``/
+                    ``insert``/``put``/``setdefault``) on such a base —
+                    without being copied out first.
+
+Why this is a leak and not just an alias: borrow-mode arrays SLICE the
+recv frame buffer (distributed/wire.py — one fresh buffer per frame,
+zero copies on the hot read path). The numpy view holds a reference to
+the whole buffer, so caching one 256-byte row pins the entire multi-MB
+frame for as long as the cache entry lives; a few thousand cached rows
+can keep gigabytes of dead frames resident. Inside the frame the views
+are free — the hazard is exactly the escape.
+
+Copy-out forms that clear the taint (the shipped idiom is
+distributed/cache.py: ``a[j].tobytes()`` per kept row before
+``_insert``):
+  ``x.copy()`` / ``x.tobytes()`` / ``x.astype(...)`` /
+  ``np.array(x)`` / ``np.ascontiguousarray(x)`` / ``bytes(x)`` /
+  ``bytearray(x)``
+
+Deliberately NOT flagged:
+  - returning a borrowed value (the caller decides whether to retain —
+    flagging returns would indict every RPC client's ``call``)
+  - locals-only use (views die with the frame; that is the point)
+  - ``np.asarray`` is NOT a copy form — it returns the same view.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.core import Checker, Finding, Module, register
+from euler_tpu.analysis.symbols import dotted
+
+CHECKER = "borrowed-buffer-escape"
+
+# method calls on a tainted base that yield an independent buffer
+_COPY_METHODS = {"copy", "tobytes", "astype"}
+# callables that copy their (tainted) argument
+_COPY_CALLS = {
+    "bytes",
+    "bytearray",
+    "np.array",
+    "numpy.array",
+    "np.ascontiguousarray",
+    "numpy.ascontiguousarray",
+}
+# container methods that retain their argument
+_RETAIN_METHODS = {"append", "add", "insert", "put", "setdefault"}
+
+
+def _is_borrow_call(node: ast.AST) -> bool:
+    """A call passing borrow=True — the taint source."""
+    if not isinstance(node, ast.Call):
+        return False
+    for kw in node.keywords:
+        if (
+            kw.arg == "borrow"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+        ):
+            return True
+    return False
+
+
+def _is_copy_call(mod: Module, node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _COPY_METHODS:
+        return True
+    canon = mod.symbols.canonical_of(f)
+    return canon in _COPY_CALLS or (dotted(f) or "") in _COPY_CALLS
+
+
+def _target_names(t: ast.AST):
+    """Plain names bound by an assignment/loop target (incl. unpacking)."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_names(e)
+    elif isinstance(t, ast.Starred):
+        yield from _target_names(t.value)
+
+
+def _escape_base(base: ast.AST, module_globals: set[str]) -> str | None:
+    """Dotted name of `base` when storing into it outlives the frame:
+    a self-attribute or a module-global container."""
+    d = dotted(base)
+    if d is None:
+        return None
+    if d.startswith("self."):
+        return d
+    root = d.split(".", 1)[0]
+    return d if root in module_globals else None
+
+
+class _Taint:
+    """Per-function taint environment for borrowed names."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.names: set[str] = set()
+
+    def expr(self, e: ast.AST, extra: set[str] = frozenset()) -> bool:
+        """Does evaluating `e` yield (or contain) a borrowed view?"""
+        if isinstance(e, ast.Call):
+            if _is_borrow_call(e):
+                return True
+            if _is_copy_call(self.mod, e):
+                return False
+            # any other call conservatively propagates (tuple(x),
+            # list(x), np.asarray(x) all keep the views alive)
+            return any(self.expr(a, extra) for a in e.args) or any(
+                self.expr(kw.value, extra) for kw in e.keywords
+            )
+        if isinstance(e, ast.Name):
+            return e.id in self.names or e.id in extra
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self._comp(e.generators, (e.elt,), extra)
+        if isinstance(e, ast.DictComp):
+            return self._comp(e.generators, (e.key, e.value), extra)
+        if isinstance(e, ast.Lambda):
+            return False
+        return any(
+            self.expr(c, extra) for c in ast.iter_child_nodes(e)
+        )
+
+    def _comp(self, generators, results, extra: set[str]) -> bool:
+        """A comprehension is tainted iff what it BUILDS is tainted:
+        iterating a borrowed list binds borrowed rows to the loop vars,
+        but `[v.copy() for v in vals]` launders every element."""
+        bound = set(extra)
+        for gen in generators:
+            if self.expr(gen.iter, bound):
+                bound |= set(_target_names(gen.target))
+        return any(self.expr(r, bound) for r in results)
+
+
+def _scan_fn(mod: Module, fn, qual: str, module_globals: set[str]):
+    taint = _Taint(mod)
+    # flow-insensitive fixpoint: borrow sources seed the set, aliases
+    # (plain assigns, rows/slices, loop targets over tainted iterables)
+    # join it until stable
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if taint.expr(node.value):
+                    for t in node.targets:
+                        for name in _target_names(t):
+                            if name not in taint.names:
+                                taint.names.add(name)
+                                changed = True
+            elif isinstance(node, ast.For):
+                if taint.expr(node.iter):
+                    for name in _target_names(node.target):
+                        if name not in taint.names:
+                            taint.names.add(name)
+                            changed = True
+    if not taint.names:
+        return
+
+    def finding(line: int, what: str) -> Finding:
+        return Finding(
+            CHECKER,
+            CHECKER,
+            mod.relpath,
+            line,
+            qual,
+            f"{what} a borrow-mode decoded view — the numpy slice pins"
+            " the ENTIRE recv frame buffer for as long as the store"
+            " lives (a few cached rows hold every multi-MB frame they"
+            " came from). Copy exactly what is kept before storing"
+            " (.copy()/.tobytes()/np.array — the distributed/cache.py"
+            " per-row tobytes form) or suppress with a reason",
+        )
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            if not taint.expr(node.value):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    base = _escape_base(t, module_globals)
+                    if base:
+                        yield finding(
+                            node.lineno, f"`{base}` is bound to"
+                        )
+                elif isinstance(t, ast.Subscript):
+                    base = _escape_base(t.value, module_globals)
+                    if base:
+                        yield finding(
+                            node.lineno, f"`{base}[...]` stores"
+                        )
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _RETAIN_METHODS
+            ):
+                base = _escape_base(f.value, module_globals)
+                if base and any(taint.expr(a) for a in node.args):
+                    yield finding(
+                        node.lineno, f"`{base}.{f.attr}(...)` retains"
+                    )
+
+
+def _scan_module(mod: Module) -> list[Finding]:
+    # cheap pre-filter: no borrow=True call anywhere, nothing to do
+    if "borrow" not in mod.source:
+        return []
+    module_globals = {
+        name
+        for stmt in mod.tree.body
+        if isinstance(stmt, ast.Assign)
+        for t in stmt.targets
+        for name in _target_names(t)
+    }
+
+    findings: list[Finding] = []
+
+    def walk_defs(body, prefix):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{stmt.name}"
+                if any(_is_borrow_call(n) for n in ast.walk(stmt)):
+                    findings.extend(
+                        _scan_fn(mod, stmt, qual, module_globals)
+                    )
+                else:
+                    walk_defs(stmt.body, f"{qual}.")
+            elif isinstance(stmt, ast.ClassDef):
+                walk_defs(stmt.body, f"{stmt.name}.")
+
+    walk_defs(mod.tree.body, "")
+    return findings
+
+
+@register
+class BorrowedBufferEscapeChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            out.extend(_scan_module(mod))
+        return out

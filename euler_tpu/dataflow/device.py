@@ -461,7 +461,12 @@ class DeviceGraphTables:
         as the dense compaction, so draws land on the same slots) packed
         into fixed-size pages in one flat buffer; per-node page table in
         `page_start`. HBM ∝ edges — no max_degree failure mode."""
-        from euler_tpu.ops.pallas_kernels import PAGE_LANES, _as_lane_rows
+        from euler_tpu.distributed.codec import page_dtype
+        from euler_tpu.ops.pallas_kernels import (
+            PAGE_LANES,
+            _as_lane_rows,
+            pack_bf16_words,
+        )
 
         P = int(page_size)
         if P <= 0 or PAGE_LANES % P:
@@ -524,10 +529,24 @@ class DeviceGraphTables:
         self.page_start = jax.device_put(ps.astype(np.int32))
         self.deg = jax.device_put(deg)
         self.unit_w = unit_w
+        # EULER_TPU_PAGE_DTYPE=bf16 packs the weight plane two-bf16-per-
+        # u32 (half the HBM + DMA bytes) and dequantizes inside the
+        # gather. Emitted batches already ship bf16 edge weights, and
+        # bf16(bf16(x)) == bf16(x), so packed draws stay BIT-IDENTICAL
+        # to the f32 plane — this lane spends no accuracy budget. Odd
+        # page sizes would let a row's page span straddle a packed word
+        # at refresh time, so P=1 stays unpacked.
+        self._page_w_packed = (
+            not unit_w and page_dtype() == "bf16" and P % 2 == 0
+        )
         if unit_w:
             self.page_w2d = self.page_q2d = self.page_bound = None
         else:
-            self.page_w2d = _as_lane_rows(jnp.asarray(flat_w))
+            self.page_w2d = _as_lane_rows(
+                pack_bf16_words(flat_w)
+                if self._page_w_packed
+                else jnp.asarray(flat_w)
+            )
             self.page_q2d = _as_lane_rows(jnp.asarray(flat_q))
             # per-page boundary = the page's last valid quantized-CDF
             # value (pads are U32_MAX, and a node's final page ends at
@@ -677,9 +696,22 @@ class DeviceGraphTables:
             valid = np.arange(block.shape[1])[None, :] < d[:, None]
             q = _quantize_rows(wblk, valid)
             qv[put] = q[sr, sc]
-            self.page_w2d = self.page_w2d.at[
-                dest // lanes, dest % lanes
-            ].set(jnp.asarray(wv))
+            if getattr(self, "_page_w_packed", False):
+                # every span is a whole-page run and P is even, so spans
+                # start word-aligned with even length: pack the patch
+                # values pairwise and rewrite whole u32 words — no
+                # read-modify-write of half-covered words can occur
+                from euler_tpu.ops.pallas_kernels import pack_bf16_words
+
+                words = pack_bf16_words(wv)
+                wdest = dest[0::2] // 2
+                self.page_w2d = self.page_w2d.at[
+                    wdest // lanes, wdest % lanes
+                ].set(words)
+            else:
+                self.page_w2d = self.page_w2d.at[
+                    dest // lanes, dest % lanes
+                ].set(jnp.asarray(wv))
             self.page_q2d = self.page_q2d.at[
                 dest // lanes, dest % lanes
             ].set(jnp.asarray(qv))
@@ -896,6 +928,7 @@ class DeviceGraphTables:
         from euler_tpu.ops.pallas_kernels import (
             paged_cdf_count,
             paged_gather,
+            paged_gather_dequant,
             paged_page_search,
         )
 
@@ -926,12 +959,16 @@ class DeviceGraphTables:
             0,
         ).reshape(-1)
         if not self.unit_w:
+            # packed plane: bf16 dequantized AT the gather (half the
+            # DMA bytes); the trailing bf16 cast below makes the packed
+            # and f32 planes emit bit-identical weights either way
+            wvals = (
+                paged_gather_dequant(self.page_w2d, fidx, impl=impl)
+                if getattr(self, "_page_w_packed", False)
+                else paged_gather(self.page_w2d, fidx, impl=impl)
+            )
             ew = (
-                jnp.where(
-                    deg[:, None] > 0,
-                    paged_gather(self.page_w2d, fidx, impl=impl),
-                    0.0,
-                )
+                jnp.where(deg[:, None] > 0, wvals, 0.0)
                 .reshape(-1)
                 .astype(jnp.bfloat16)
             )

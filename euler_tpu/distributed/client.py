@@ -16,6 +16,7 @@ unchanged against a cluster.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import socket
@@ -110,11 +111,21 @@ def _seed(rng) -> int:
 
 
 class _Replica:
-    def __init__(self, host: str, port: int, shard: int | None = None):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        shard: int | None = None,
+        counters: tuple | None = None,
+    ):
         self.host = host
         self.port = port
         self.shard = shard  # chaos-plan matching + diagnostics only
         self.bad_until = 0.0
+        # optional (bytes_out Counter, bytes_in Counter) pair shared
+        # across the owning shard handle's replicas — per-verb wire
+        # bytes, GIL-racy increments fine (telemetry, not an invariant)
+        self.counters = counters
         self._local = threading.local()
 
     def _sock(self, timeout_s: float | None = None) -> socket.socket:
@@ -169,8 +180,13 @@ class _Replica:
         # vectored send + borrow decode: request arrays ride as iovecs,
         # response arrays slice the (per-frame, never-mutated) recv
         # buffer — zero staging copies on either direction of the wire
-        wire.send_frame(sock, wire.encode_vectored(wire_op, values))
+        frame = wire.encode_vectored(wire_op, values)
+        if self.counters is not None:
+            self.counters[0][op] += wire.frame_nbytes(frame)
+        wire.send_frame(sock, frame)
         payload = wire.read_frame(sock)
+        if payload is not None and self.counters is not None:
+            self.counters[1][op] += 4 + len(payload)
         if payload is None:
             # clean EOF — the server closed this connection (shutdown or
             # restart): a transport failure, so the caller fails over,
@@ -255,12 +271,20 @@ class RemoteShard:
         retry_policy: RetryPolicy | None = None,
     ):
         self.shard = shard
+        # per-verb wire bytes this handle put on / read off the socket
+        # (client half of the byte-budget story; the server half lives
+        # in GraphService.wire_bytes_in/out). Shared by every replica.
+        self.wire_bytes_out: collections.Counter = collections.Counter()
+        self.wire_bytes_in: collections.Counter = collections.Counter()
+        self._counters = (self.wire_bytes_out, self.wire_bytes_in)
         # copy-on-write tuple (same discipline as _Engine/merge_delta):
         # readers grab ONE reference and index it; membership changes
         # build a new tuple and swap it in a single assignment under the
         # lock. The old list form let add_replica .append() into a list
         # that _pick was concurrently indexing — a torn round-robin scan.
-        self.replicas = tuple(_Replica(h, p, shard) for h, p in replicas)
+        self.replicas = tuple(
+            _Replica(h, p, shard, self._counters) for h, p in replicas
+        )
         self._rr = 0
         self._lock = threading.Lock()
         self._num_nodes: int | None = None
@@ -280,6 +304,11 @@ class RemoteShard:
         # answer edges_by_rows with unknown-op, after which this handle
         # assembles the export from chunked per-row verbs instead
         self._edges_wire = True
+        # sticky dense-wire-dtype downgrade (PR 16): a server predating
+        # the trailing wire-dtype arg ignores it and answers the exact
+        # f32 block; one such answer pins this handle to f32 (exact,
+        # bit-identical old behavior) instead of re-offering every call
+        self._dense_wire = True
         # logical RPCs issued through this shard handle (retries count
         # once) — the client half of the planner's L×P → P measurement;
         # GIL-racy increments are fine for telemetry
@@ -352,7 +381,7 @@ class RemoteShard:
             # COW: one reference swap, never in-place mutation — _pick
             # indexes whatever tuple it snapshotted without tearing
             self.replicas = self.replicas + (
-                _Replica(host, port, self.shard),
+                _Replica(host, port, self.shard, self._counters),
             )
 
     def sync_replicas(self, addrs: list[tuple[str, int]]):
@@ -369,7 +398,8 @@ class RemoteShard:
         with self._lock:
             have = {(r.host, r.port): r for r in self.replicas}
             self.replicas = tuple(
-                have.get(a) or _Replica(a[0], a[1], self.shard)
+                have.get(a)
+                or _Replica(a[0], a[1], self.shard, self._counters)
                 for a in want
             )
 
@@ -388,7 +418,7 @@ class RemoteShard:
                     # a preferred address the registry/redirect told us
                     # about but the pool has never seen — a replacement
                     # replica on a NEW port. Adopt it.
-                    r = _Replica(host, port, self.shard)
+                    r = _Replica(host, port, self.shard, self._counters)
                     self.replicas = reps + (r,)
                     return r
             for _ in range(len(reps)):
@@ -515,6 +545,11 @@ class RemoteShard:
             # graph_epoch invalidates the cache right here
             self._cache.observe_epoch(out.get("graph_epoch", 0))
             out["read_cache"] = self._cache.stats()
+        # this handle's view of the same byte streams the server counts
+        # in wire_bytes_in/out — client-side so it also covers bytes the
+        # server never saw (torn sends, failed-over attempts)
+        out["client_wire_bytes_out"] = dict(self.wire_bytes_out)
+        out["client_wire_bytes_in"] = dict(self.wire_bytes_in)
         return out
 
     # -- read cache plumbing --------------------------------------------
@@ -581,7 +616,8 @@ class RemoteShard:
         cached — planners then skip the server-side feature step."""
         c = self._cache
         return c is not None and c.covers(
-            ("dense", tuple(names)), np.asarray(ids, np.uint64)
+            self._dense_key("dense", names, self._dense_wire_kind()),
+            np.asarray(ids, np.uint64),
         )
 
     def lookup(self, ids):
@@ -630,9 +666,16 @@ class RemoteShard:
         rows = np.asarray(rows, np.int64)
         if self._edges_wire:
             try:
-                c, d, w, t = self.call(
-                    "edges_by_rows", [rows, _types(edge_types)]
-                )
+                req = [rows, _types(edge_types)]
+                if _delta_wire():
+                    # offer the compact dst plane; old servers ignore
+                    # the extra arg and answer raw u64 (dtype tells)
+                    req.append("delta")
+                c, d, w, t = self.call("edges_by_rows", req)
+                if np.asarray(d).dtype == np.uint8:
+                    from euler_tpu.distributed import codec
+
+                    d = codec.decode_u64_delta(np.asarray(d).tobytes())
                 return (
                     np.asarray(c, np.int64), np.asarray(d, np.uint64),
                     np.asarray(w, np.float32), np.asarray(t, np.int32),
@@ -713,9 +756,8 @@ class RemoteShard:
             # cap-less responses are padded to the BATCH max degree —
             # per-id rows then depend on their neighbors in the request,
             # so only fixed-cap calls are cacheable
-            out = self.call(
-                "get_full_neighbor",
-                [ids, _types(edge_types), max_degree, in_edges, sort_by],
+            out = self._full_nb_call(
+                ids, edge_types, max_degree, in_edges, sort_by
             )
             return _bool_mask(out, 3)
         key = (
@@ -728,12 +770,33 @@ class RemoteShard:
         out = c.fetch(
             key,
             ids,
-            lambda miss: self.call(
-                "get_full_neighbor",
-                [miss, _types(edge_types), int(max_degree), in_edges, sort_by],
+            lambda miss: self._full_nb_call(
+                miss, edge_types, int(max_degree), in_edges, sort_by
             ),
         )
         return _bool_mask(out, 3)
+
+    def _full_nb_call(self, ids, edge_types, max_degree, in_edges, sort_by):
+        """One get_full_neighbor RPC, offering the varint neighbor-id
+        plane (PR 16). Old servers ignore the trailing arg and answer
+        raw u64; a u8 plane is the compact form, decoded (exact) BEFORE
+        the caller's cache sees it — cached blocks stay plain u64."""
+        req = [ids, _types(edge_types), max_degree, in_edges, sort_by]
+        if _delta_wire():
+            req.append("delta")
+        out = self.call("get_full_neighbor", req)
+        nbr = np.asarray(out[0])
+        if nbr.dtype == np.uint8:
+            from euler_tpu.distributed import codec
+
+            flat = codec.decode_u64_delta(nbr.tobytes())
+            out = list(out)
+            out[0] = (
+                flat.reshape(len(ids), -1)
+                if flat.size
+                else flat.reshape(len(ids), 0)
+            )
+        return out
 
     def get_top_k_neighbor(self, ids, edge_types=None, k=10, in_edges=False):
         out = self.call(
@@ -940,31 +1003,104 @@ class RemoteShard:
             "labels": out[6],
         }
 
+    # -- dense features: quantized wire (PR 16) -------------------------
+
+    def _dense_wire_kind(self) -> str:
+        """The wire dtype this handle asks dense replies in:
+        EULER_TPU_PAGE_DTYPE unless the peer proved old (sticky f32)."""
+        if not self._dense_wire:
+            return "f32"
+        from euler_tpu.distributed import codec
+
+        return codec.page_dtype()
+
+    @staticmethod
+    def _dense_key(base: str, names, kind: str) -> tuple:
+        # f32 keeps the pre-PR-16 key so warm caches survive the upgrade;
+        # quantized blocks get their own keyspace (different structure)
+        if kind == "f32":
+            return (base, tuple(names))
+        return (base, tuple(names), kind)
+
+    def _dense_miss(self, verb: str, miss, names: list, kind: str) -> list:
+        out = self.call(verb, [miss, names, kind])
+        if len(out) == 1 and np.asarray(out[0]).dtype == np.float32:
+            # a server predating the trailing wire-dtype arg ignored it
+            # and answered the exact f32 block: degrade (sticky) and
+            # keep the reply verbatim — bit-identical old behavior,
+            # never a client-side re-quantization
+            self._dense_wire = False
+        return out
+
+    @staticmethod
+    def _dense_decode(kind: str, parts: list) -> np.ndarray:
+        """Wire/cache dense parts → f32 rows. A lone f32 part under a
+        quantized kind is the degrade path's exact block — verbatim."""
+        if len(parts) == 1 and np.asarray(parts[0]).dtype == np.float32:
+            return parts[0]
+        from euler_tpu.distributed import codec
+
+        return codec.dequantize(kind, parts)
+
     def get_dense_feature(self, ids, names):
         ids = np.asarray(ids, np.uint64)
+        kind = self._dense_wire_kind()
         c = self._cached()
         if c is None:
-            return self.call("get_dense_feature", [ids, list(names)])[0]
-        return c.fetch(
-            ("dense", tuple(names)),
+            return self._dense_decode(
+                kind, self._dense_miss(
+                    "get_dense_feature", ids, list(names), kind
+                ) if kind != "f32" else [
+                    self.call("get_dense_feature", [ids, list(names)])[0]
+                ],
+            )
+        if kind == "f32":
+            return c.fetch(
+                ("dense", tuple(names)),
+                ids,
+                lambda miss: [
+                    self.call("get_dense_feature", [miss, list(names)])[0]
+                ],
+            )[0]
+        # the cache stores QUANTIZED blocks (that is the warm-cache byte
+        # saving); dequantize after assembly, per fetch
+        parts = c.fetch(
+            self._dense_key("dense", names, kind),
             ids,
-            lambda miss: [
-                self.call("get_dense_feature", [miss, list(names)])[0]
-            ],
-        )[0]
+            lambda miss: self._dense_miss(
+                "get_dense_feature", miss, list(names), kind
+            ),
+        )
+        return self._dense_decode(kind, parts)
 
     def get_dense_by_rows(self, rows, names):
         rows = np.asarray(rows, np.int64)
+        kind = self._dense_wire_kind()
         c = self._cached()
         if c is None:
-            return self.call("get_dense_by_rows", [rows, list(names)])[0]
-        return c.fetch(
-            ("dense_rows", tuple(names)),
+            return self._dense_decode(
+                kind, self._dense_miss(
+                    "get_dense_by_rows", rows, list(names), kind
+                ) if kind != "f32" else [
+                    self.call("get_dense_by_rows", [rows, list(names)])[0]
+                ],
+            )
+        if kind == "f32":
+            return c.fetch(
+                ("dense_rows", tuple(names)),
+                rows,
+                lambda miss: [
+                    self.call("get_dense_by_rows", [miss, list(names)])[0]
+                ],
+            )[0]
+        parts = c.fetch(
+            self._dense_key("dense_rows", names, kind),
             rows,
-            lambda miss: [
-                self.call("get_dense_by_rows", [miss, list(names)])[0]
-            ],
-        )[0]
+            lambda miss: self._dense_miss(
+                "get_dense_by_rows", miss, list(names), kind
+            ),
+        )
+        return self._dense_decode(kind, parts)
 
     def get_dense_feature_udf(self, ids, names, udfs):
         """Server-side UDF aggregation (udf.h API_GET_P semantics): the
@@ -1094,6 +1230,15 @@ def _dnf_json(dnf) -> str:
 
 def _types(edge_types):
     return None if edge_types is None else [int(t) for t in edge_types]
+
+
+def _delta_wire() -> bool:
+    """Whether this client OFFERS varint neighbor planes — rides the
+    stream-codec knob, so EULER_TPU_WIRE_CODEC=id is one switch back to
+    raw wire everywhere (the bench's uncompressed A/B leg)."""
+    from euler_tpu.distributed import codec
+
+    return codec.wire_codec() != codec.IDENTITY
 
 
 def _bool_mask(out: list, idx: int):

@@ -1,0 +1,319 @@
+"""Compact-bytes codecs: the ONE seam every shrunk byte path rides.
+
+Three independent codec families live here, because they shrink three
+different kinds of bytes:
+
+- **Stream codecs** (`compress`/`decompress`): lossless frame-level
+  compression for replication and DR streams — `wal_ship` batches,
+  snapshot bootstrap payloads, backup archives. `"id"` is the identity
+  (and the degrade target against pre-codec peers), `"zlib"` is always
+  available, `"zstd"` only when the interpreter already ships it (the
+  container never pip-installs; the registry gates on importability).
+  Every compressed blob is framed `[u8 version][u32 raw_len]
+  [u32 raw_crc32][payload]` so a flipped byte surfaces as a typed
+  ValueError — from the header check, the decompressor, the length
+  check, or the crc — never as silently-wrong bytes.
+
+- **Integer delta+varint** (`encode_u64_delta`/`decode_u64_delta`):
+  exact compaction for neighbor-id planes (`full_nb`, `edges_by_rows`
+  hub pages). First-difference zigzag + LEB128 varint over the u64 id
+  stream: sorted neighbor lists collapse to ~1-2 bytes/id, and because
+  zigzag handles negative deltas the roundtrip is bit-identical for ANY
+  order — sortedness is an efficiency assumption, never a correctness
+  one. Same corruption framing as the stream codecs.
+
+- **Float quantizers** (`quantize`/`dequantize`): the ONLY lossy path
+  in the repo, for dense-feature wire payloads and HBM feature pages.
+  `"bf16"` truncates mantissas (rel error <= 2^-8, PARITY.md budget);
+  `"int8"` is per-row affine (uint8 + per-row scale/zero-point, abs
+  error <= (rowmax-rowmin)/254). `"f32"` is the exact default —
+  fp32 bit-parity is relinquished only when a caller opts in.
+
+Knobs:
+  EULER_TPU_WIRE_CODEC   — stream codec for negotiated wire paths
+                           (default "zlib"; "id" disables)
+  EULER_TPU_PAGE_DTYPE   — feature page/wire dtype ("f32" default,
+                           "bf16", "int8")
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; bf16 wire arrays already ride dtype
+    # code 8 in graph/format.py
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+try:  # zstd is OPTIONAL: never installed, only detected
+    import zstandard as _zstd  # type: ignore
+except ImportError:  # pragma: no cover - container has no zstd wheel
+    _zstd = None
+
+# blob framing: version byte, raw length, crc32 of the RAW bytes — the
+# decompress path re-checks all three so malformed input is a typed
+# error, never silently-wrong bytes
+_FRAME = struct.Struct("<BII")
+_FRAME_VERSION = 1
+
+IDENTITY = "id"
+
+
+def wire_codec() -> str:
+    """The negotiated stream codec this process OFFERS on the wire
+    (EULER_TPU_WIRE_CODEC; peers that don't speak it degrade to "id")."""
+    name = os.environ.get("EULER_TPU_WIRE_CODEC", "zlib").strip() or "id"
+    return name if name in available_codecs() else IDENTITY
+
+
+def page_dtype() -> str:
+    """EULER_TPU_PAGE_DTYPE: feature page/wire quantization ("f32"
+    exact default / "bf16" / "int8")."""
+    name = os.environ.get("EULER_TPU_PAGE_DTYPE", "f32").strip() or "f32"
+    if name not in ("f32", "bf16", "int8"):
+        raise ValueError(
+            f"EULER_TPU_PAGE_DTYPE={name!r}: expected f32, bf16, or int8"
+        )
+    return name
+
+
+def available_codecs() -> tuple[str, ...]:
+    out = [IDENTITY, "zlib"]
+    if _zstd is not None:  # pragma: no cover - optional dependency
+        out.append("zstd")
+    return tuple(out)
+
+
+def compress(name: str, data: bytes) -> bytes:
+    """`data` -> framed compressed blob under codec `name` ("id" frames
+    too, so the decode side always has the crc to check)."""
+    data = bytes(data)
+    head = _FRAME.pack(
+        _FRAME_VERSION, len(data), zlib.crc32(data) & 0xFFFFFFFF
+    )
+    if name == IDENTITY:
+        return head + data
+    if name == "zlib":
+        return head + zlib.compress(data, 1)
+    if name == "zstd" and _zstd is not None:  # pragma: no cover - optional
+        return head + _zstd.ZstdCompressor(level=1).compress(data)
+    raise ValueError(f"unknown stream codec {name!r}")
+
+
+def decompress(name: str, blob: bytes) -> bytes:
+    """Framed blob -> raw bytes; ANY damage (bad frame, bad stream,
+    length or crc mismatch) raises ValueError."""
+    blob = bytes(blob)
+    if len(blob) < _FRAME.size:
+        raise ValueError(
+            f"codec {name!r}: blob shorter than its frame header"
+        )
+    ver, raw_len, raw_crc = _FRAME.unpack_from(blob, 0)
+    if ver != _FRAME_VERSION:
+        raise ValueError(f"codec {name!r}: unknown frame version {ver}")
+    body = blob[_FRAME.size:]
+    if name == IDENTITY:
+        raw = body
+    elif name == "zlib":
+        try:
+            raw = zlib.decompress(body)
+        except zlib.error as e:
+            raise ValueError(f"codec zlib: corrupt stream ({e})") from e
+    elif name == "zstd" and _zstd is not None:  # pragma: no cover
+        try:
+            raw = _zstd.ZstdDecompressor().decompress(
+                body, max_output_size=max(raw_len, 1)
+            )
+        except _zstd.ZstdError as e:
+            raise ValueError(f"codec zstd: corrupt stream ({e})") from e
+    else:
+        raise ValueError(f"unknown stream codec {name!r}")
+    if len(raw) != raw_len:
+        raise ValueError(
+            f"codec {name!r}: decoded {len(raw)} bytes, frame declared"
+            f" {raw_len}"
+        )
+    if zlib.crc32(raw) & 0xFFFFFFFF != raw_crc:
+        raise ValueError(f"codec {name!r}: decoded bytes fail frame crc")
+    return raw
+
+
+# ---------------------------------------------------------------------------
+# exact integer delta + varint (neighbor-id planes)
+# ---------------------------------------------------------------------------
+
+
+def _zigzag(d: np.ndarray) -> np.ndarray:
+    # signed first differences -> unsigned, small-magnitude-small codes
+    d = d.astype(np.int64)
+    return ((d << 1) ^ (d >> 63)).astype(np.uint64)
+
+
+def encode_u64_delta(arr) -> bytes:
+    """u64 array -> framed zigzag-delta LEB128 varint bytes. Exact for
+    ANY value order (zigzag absorbs negative deltas); sorted runs are
+    where the bytes shrink. Frame carries count + crc of the raw ids so
+    decode can type-check damage."""
+    arr = np.ascontiguousarray(arr, dtype=np.uint64)
+    flat = arr.reshape(-1)
+    raw = flat.tobytes()
+    head = struct.pack(
+        "<BQI", _FRAME_VERSION, flat.size, zlib.crc32(raw) & 0xFFFFFFFF
+    )
+    if flat.size == 0:
+        return head
+    # first value verbatim-varint; the rest zigzag first differences.
+    # int64 wraparound on the diff is fine: zigzag/unzigzag is a
+    # bijection on the 64-bit ring, so decode adds the same wrapped
+    # delta back.
+    vals = np.empty(flat.size, np.uint64)
+    vals[0] = flat[0]
+    vals[1:] = _zigzag(
+        (flat[1:].astype(np.int64) - flat[:-1].astype(np.int64))
+    )
+    out = bytearray()
+    for v in vals.tolist():
+        while v >= 0x80:
+            out.append((v & 0x7F) | 0x80)
+            v >>= 7
+        out.append(v)
+    return head + bytes(out)
+
+
+def decode_u64_delta(blob) -> np.ndarray:
+    """Inverse of encode_u64_delta; malformed input (truncated varint,
+    trailing garbage, count/crc mismatch) raises ValueError."""
+    blob = bytes(blob)
+    head = struct.Struct("<BQI")
+    if len(blob) < head.size:
+        raise ValueError("varint block shorter than its header")
+    ver, count, crc = head.unpack_from(blob, 0)
+    if ver != _FRAME_VERSION:
+        raise ValueError(f"varint block: unknown version {ver}")
+    pos, end = head.size, len(blob)
+    # every value takes >= 1 byte: a corrupt count cannot be allowed to
+    # size the allocation (a flipped header byte would ask for TiB)
+    if count > end - pos:
+        raise ValueError(
+            f"varint block declares {count} values but carries only"
+            f" {end - pos} payload bytes"
+        )
+    vals = np.empty(count, np.uint64)
+    for i in range(count):
+        shift = 0
+        acc = 0
+        while True:
+            if pos >= end:
+                raise ValueError(
+                    f"varint block truncated at value {i}/{count}"
+                )
+            b = blob[pos]
+            pos += 1
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift >= 70:
+                raise ValueError("varint value overruns 64 bits")
+        if acc >> 64:
+            raise ValueError("varint value overruns 64 bits")
+        vals[i] = np.uint64(acc)
+    if pos != end:
+        raise ValueError(
+            f"varint block has {end - pos} trailing bytes after"
+            f" {count} values"
+        )
+    if count:
+        # un-zigzag the delta tail, then prefix-sum on the u64 ring
+        d = vals[1:]
+        sd = ((d >> np.uint64(1)) ^ (-(d & np.uint64(1)).astype(np.int64))
+              .astype(np.uint64))
+        vals[1:] = sd
+        vals = np.cumsum(vals.astype(np.uint64), dtype=np.uint64)
+    raw = vals.tobytes()
+    if zlib.crc32(raw) & 0xFFFFFFFF != crc:
+        raise ValueError("varint block decodes to bytes failing its crc")
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# float quantizers (the one lossy path; budgets pinned in PARITY.md)
+# ---------------------------------------------------------------------------
+
+
+def quantize(kind: str, vals: np.ndarray):
+    """f32 [n, F] -> list of wire arrays for `kind`:
+    "f32" -> [vals] (exact); "bf16" -> [bf16 vals]; "int8" ->
+    [uint8 q, f32 scale [n], f32 zero [n]] per-row affine."""
+    vals = np.ascontiguousarray(vals, np.float32)
+    if kind == "f32":
+        return [vals]
+    if kind == "bf16":
+        if _BF16 is None:  # pragma: no cover - ml_dtypes ships with jax
+            raise ValueError("bf16 pages need ml_dtypes (ships with jax)")
+        return [vals.astype(_BF16)]
+    if kind == "int8":
+        if vals.ndim != 2:
+            vals = vals.reshape(len(vals), -1)
+        lo = vals.min(axis=1, initial=0.0)
+        hi = vals.max(axis=1, initial=0.0)
+        scale = np.maximum((hi - lo) / 255.0, np.float32(1e-30)).astype(
+            np.float32
+        )
+        q = np.clip(
+            np.rint((vals - lo[:, None]) / scale[:, None]), 0, 255
+        ).astype(np.uint8)
+        return [q, scale, lo.astype(np.float32)]
+    raise ValueError(f"unknown page dtype {kind!r}")
+
+
+def dequantize(kind: str, parts) -> np.ndarray:
+    """Inverse of quantize back to f32 (exact for f32, budgeted for
+    bf16/int8). Malformed part lists raise ValueError."""
+    if kind == "f32":
+        (vals,) = parts
+        return np.ascontiguousarray(vals, np.float32)
+    if kind == "bf16":
+        (vals,) = parts
+        return np.asarray(vals).astype(np.float32)
+    if kind == "int8":
+        if len(parts) != 3:
+            raise ValueError(
+                f"int8 payload needs [q, scale, zero], got {len(parts)}"
+                " arrays"
+            )
+        q, scale, zero = parts
+        q = np.asarray(q)
+        if q.dtype != np.uint8:
+            raise ValueError(f"int8 payload q plane has dtype {q.dtype}")
+        return (
+            q.astype(np.float32) * np.asarray(scale, np.float32)[:, None]
+            + np.asarray(zero, np.float32)[:, None]
+        )
+    raise ValueError(f"unknown page dtype {kind!r}")
+
+
+def quant_error_budget(kind: str, vals: np.ndarray) -> np.ndarray:
+    """Per-row max-abs-error budget the PARITY.md contract pins: the
+    tests assert |dequant(quant(x)) - x| stays under this, elementwise."""
+    vals = np.ascontiguousarray(vals, np.float32)
+    if vals.ndim != 2:
+        vals = vals.reshape(len(vals), -1)
+    if kind == "f32":
+        return np.zeros(len(vals), np.float32)
+    if kind == "bf16":
+        # one bf16 rounding: rel error <= 2^-9 of the magnitude; budget
+        # 2^-8 leaves headroom for subnormal edges
+        return np.abs(vals).max(axis=1, initial=0.0) * np.float32(2**-8)
+    if kind == "int8":
+        lo = vals.min(axis=1, initial=0.0)
+        hi = vals.max(axis=1, initial=0.0)
+        return ((hi - lo) / 254.0).astype(np.float32)
+    raise ValueError(f"unknown page dtype {kind!r}")

@@ -105,8 +105,17 @@ class _PrimaryLink:
         self.host = str(host)
         self.port = int(port)
         self._sock: socket.socket | None = None
+        # None until the first log-mode reply: True once the primary
+        # answered the extended (codec-aware) wal_ship reply shape,
+        # False for a pre-codec peer — the STICKY degrade bit. Only a
+        # proven-new primary may be pipelined: an old primary reads each
+        # request's from_pos as the durable ack, and a speculative
+        # request would over-ack bytes not yet fsync'd here.
+        self.new_proto: bool | None = None
 
-    def _call(self, op: str, values: list, timeout_s: float | None = None):
+    def _send(self, op: str, values: list, timeout_s: float | None = None):
+        """Fire one request without waiting for its reply — the
+        pipelining half; pair each _send with exactly one _recv."""
         to = (
             timeout_s if timeout_s is not None
             else float(os.environ.get("EULER_TPU_SHIP_TIMEOUT_S", "10.0"))
@@ -120,6 +129,8 @@ class _PrimaryLink:
             )
         self._sock.settimeout(to)
         wire.send_frame(self._sock, wire.encode_vectored(op, values))
+
+    def _recv(self):
         payload = wire.read_frame(self._sock)
         if payload is None:
             raise ConnectionError("connection closed by peer")
@@ -127,6 +138,10 @@ class _PrimaryLink:
         if status == "err":
             raise from_wire(result[0])
         return result
+
+    def _call(self, op: str, values: list, timeout_s: float | None = None):
+        self._send(op, values, timeout_s)
+        return self._recv()
 
     def close(self):
         sock, self._sock = self._sock, None
@@ -179,15 +194,24 @@ class ReplicaCoordinator:
         # primary side: follower rid → durable shipped position
         self._pos_cond = threading.Condition()
         self._positions: dict[int, int] = {}
+        # positions quorum committers are currently parked on (guarded
+        # by _pos_cond) — shipper long-polls consult these via
+        # ack_wanted() so a stale-ack request never stalls a commit
+        self._commit_waiting: list[int] = []
         # shippers long-poll on this for the next committed record
         self._ship_cond = threading.Condition()
         self._link: _PrimaryLink | None = None
-        # telemetry (GIL-racy increments fine — repo counter stance)
+        # telemetry (GIL-racy increments fine — repo counter stance).
+        # ship_bytes counts LOGICAL (decoded) log bytes — the catch-up
+        # MB/s numerator; ship_wire_bytes counts what actually crossed
+        # the wire, so the pair exposes the compression ratio.
         self.promotions = 0
         self.demotions = 0
         self.bootstraps = 0
         self.ship_batches = 0
         self.ship_bytes = 0
+        self.ship_wire_bytes = 0
+        self.ship_pipelined = 0
 
     # -- lifecycle -------------------------------------------------------
 
@@ -242,19 +266,31 @@ class ReplicaCoordinator:
         needed = min((self.group_size + 1) // 2, self.group_size - 1)
         deadline = time.monotonic() + self.ack_timeout
         with self._pos_cond:
-            while (
-                sum(1 for p in self._positions.values() if p >= pos)
-                < needed
-            ):
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    raise OverloadError(
-                        f"quorum ack timeout: {needed} follower ack(s)"
-                        f" past pos {pos} not reached within"
-                        f" {self.ack_timeout}s"
-                        f" (followers at {dict(self._positions)})"
-                    )
-                self._pos_cond.wait(min(left, 0.1))
+            self._commit_waiting.append(pos)
+            try:
+                while (
+                    sum(1 for p in self._positions.values() if p >= pos)
+                    < needed
+                ):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise OverloadError(
+                            f"quorum ack timeout: {needed} follower"
+                            f" ack(s) past pos {pos} not reached within"
+                            f" {self.ack_timeout}s"
+                            f" (followers at {dict(self._positions)})"
+                        )
+                    self._pos_cond.wait(min(left, 0.1))
+            finally:
+                self._commit_waiting.remove(pos)
+
+    def ack_wanted(self, ack_pos: int) -> bool:
+        """True when a quorum committer is parked on a position past
+        `ack_pos` — the shipper long-poll answers empty instead of
+        parking such a request, so a pipelined follower whose durable
+        ack trails its speculative from_pos can refresh the ack now."""
+        with self._pos_cond:
+            return any(p > ack_pos for p in self._commit_waiting)
 
     def note_follower(self, rid: int, pos: int) -> None:
         """A ship request's from_pos IS the follower's durable ack."""
@@ -267,14 +303,19 @@ class ReplicaCoordinator:
 
     def wait_for_append(self, from_pos: int, timeout_s: float) -> None:
         """Server-side long poll: block (briefly) until the log grows
-        past `from_pos` or the timeout lapses."""
+        past `from_pos` or the timeout lapses. Predicate loop — a
+        notify for an unrelated event never ends the poll early with
+        no data."""
         wal = self.service._wal
         if wal is None:
             return
+        deadline = time.monotonic() + max(min(timeout_s, 1.0), 0.0)
         with self._ship_cond:
-            if wal.tell() > from_pos or self._stop.is_set():
-                return
-            self._ship_cond.wait(max(min(timeout_s, 1.0), 0.0))
+            while wal.tell() <= from_pos and not self._stop.is_set():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return
+                self._ship_cond.wait(left)
 
     def status(self) -> dict:
         with self._pos_cond:
@@ -293,6 +334,10 @@ class ReplicaCoordinator:
             "promotions": self.promotions,
             "demotions": self.demotions,
             "bootstraps": self.bootstraps,
+            "ship_batches": int(self.ship_batches),
+            "ship_bytes": int(self.ship_bytes),
+            "ship_wire_bytes": int(self.ship_wire_bytes),
+            "ship_pipelined": int(self.ship_pipelined),
         }
 
     # -- lease state machine ---------------------------------------------
@@ -477,13 +522,51 @@ class ReplicaCoordinator:
                 self._drop_link()
                 self._stop.wait(0.1)
 
+    @staticmethod
+    def _ship_args(pos, max_bytes, rid, crc, clen, poll_ms, offer, ack):
+        """wal_ship request: the first seven args are the PR-13 shape an
+        old primary dispatches on positionally; the codec offer and the
+        EXPLICIT durable ack ride as trailing args old primaries ignore
+        (they fall back to reading from_pos as the ack, which is why
+        pipelining stays off until the new reply shape is proven)."""
+        return [pos, max_bytes, rid, "log", crc, clen, poll_ms, offer, ack]
+
+    @staticmethod
+    def _ship_payload(link: _PrimaryLink, reply) -> bytes:
+        """Decode one log-mode reply's record bytes, learning (sticky)
+        whether the primary speaks the codec-aware reply shape. Damaged
+        compressed payloads raise ValueError — the tail loop treats that
+        as a transport fault, never applies the bytes."""
+        from euler_tpu.distributed import codec
+
+        data = reply[1]
+        raw = bytes(np.ascontiguousarray(data)) if len(data) else b""
+        if len(reply) >= 6:
+            link.new_proto = True
+            return codec.decompress(str(reply[4]), raw)
+        link.new_proto = False
+        return raw
+
+    # unsynced pipelined batches a catch-up stream accumulates before a
+    # group fsync advances the reported durable ack
+    _SYNC_EVERY = 32
+
     def _tail_once(self, addr, max_bytes: int, poll_ms: float):
+        from euler_tpu.distributed import codec
+
         link = self._get_link(addr)
+        pipeline = (
+            os.environ.get("EULER_TPU_SHIP_PIPELINE", "1") != "0"
+        )
+        offer = codec.wire_codec()
         pos, crc, clen = self.service.wal_tail_probe()
         try:
             reply = link._call(
                 "wal_ship",
-                [pos, max_bytes, self.rid, "log", crc, clen, poll_ms],
+                self._ship_args(
+                    pos, max_bytes, self.rid, crc, clen, poll_ms, offer,
+                    pos,
+                ),
             )
         except RpcError:
             # typed server verdict (e.g. the peer has no WAL, or an old
@@ -491,39 +574,147 @@ class ReplicaCoordinator:
             self._drop_link()
             self._stop.wait(0.2)
             return
-        term, data, end, need = (
-            int(reply[0]), reply[1], int(reply[2]), bool(reply[3])
-        )
-        if term < self.term:
-            # a fenced ex-primary still answering its old connections:
-            # its records must not enter our log
-            self._drop_link()
-            self._stop.wait(0.2)
-            return
-        self.term = max(self.term, term)
-        if need:
-            self._bootstrap(link)
-            return
-        blob = bytes(np.ascontiguousarray(data)) if len(data) else b""
-        if blob:
-            newpos = self.service.apply_shipped(blob, pos)
-            self.ship_batches += 1
-            self.ship_bytes += len(blob)
-            self.heartbeat_meta["pos"] = int(newpos)
-        else:
-            self.heartbeat_meta["pos"] = int(pos)
+        # ship/apply loop, two pipelined modes against a proven-new
+        # primary (the reply's log_end says which):
+        #  - BEHIND (throughput): the next request goes out BEFORE this
+        #    batch's apply — the primary's read+compress+send overlaps
+        #    the follower's apply — and fsync is deferred across up to
+        #    _SYNC_EVERY batches (the ack only advances after a sync);
+        #    the lockstep request-fsync-reply gap capped catch-up MB/s.
+        #  - CAUGHT UP (latency): apply+fsync FIRST, then park a
+        #    request carrying the fresh durable ack — a quorum commit
+        #    unblocks one send after the follower's fsync, with no
+        #    re-handshake (tail-crc probe) on the write path.
+        durable = pos  # last fsync-covered position (the ack we report)
+        unsynced = 0
+        try:
+            while True:
+                term, end = int(reply[0]), int(reply[2])
+                need = bool(reply[3])
+                if term < self.term:
+                    # a fenced ex-primary still answering its old
+                    # connections: its records must not enter our log
+                    self._drop_link()
+                    self._stop.wait(0.2)
+                    return
+                self.term = max(self.term, term)
+                if need:
+                    self._bootstrap(link)
+                    return
+                wire_len = int(len(reply[1]))
+                blob = self._ship_payload(link, reply)
+                if not blob:
+                    self.heartbeat_meta["pos"] = int(pos)
+                    return
+                log_end = int(reply[6]) if len(reply) >= 7 else end
+                can_pipe = (
+                    pipeline
+                    and link.new_proto
+                    and self.role == "follower"
+                    and not self._stop.is_set()
+                )
+                if can_pipe and end < log_end:
+                    # behind: overlap — request first, deferred fsync.
+                    # ack_pos stays at `durable` so quorum accounting
+                    # never sees bytes not yet fsync'd here. No
+                    # tail-crc on the speculative leg: same socket +
+                    # same primary log makes the suffix continuous by
+                    # construction; the next non-pipelined cycle
+                    # re-runs the full handshake.
+                    link._send(
+                        "wal_ship",
+                        self._ship_args(
+                            end, max_bytes, self.rid, 0, 0, poll_ms,
+                            offer, durable,
+                        ),
+                    )
+                    newpos = self.service.apply_shipped(
+                        blob, pos, durable=False
+                    )
+                    unsynced += 1
+                    if unsynced >= self._SYNC_EVERY:
+                        self.service._wal.sync()
+                        durable, unsynced = newpos, 0
+                elif can_pipe:
+                    # caught up: durable append first so the next
+                    # request's ack is fresh the moment the primary
+                    # reads it — and send that ack from inside the
+                    # apply, BEFORE the staging replay (durability is
+                    # what the quorum certifies; the replay rides
+                    # behind the commit path). Send faults are noted,
+                    # never raised: the replay must run regardless or
+                    # the appended records would skip the delta store.
+                    sent = []
+
+                    def _ack_now(newend):
+                        try:
+                            link._send(
+                                "wal_ship",
+                                self._ship_args(
+                                    newend, max_bytes, self.rid, 0, 0,
+                                    poll_ms, offer, newend,
+                                ),
+                            )
+                            sent.append(newend)
+                        except OSError:
+                            pass
+
+                    newpos = self.service.apply_shipped(
+                        blob, pos, acked=_ack_now
+                    )
+                    durable, unsynced = newpos, 0
+                    if not sent:
+                        self._drop_link()
+                        return
+                    end = newpos  # the in-flight request resumes here
+                else:
+                    newpos = self.service.apply_shipped(blob, pos)
+                    durable, unsynced = newpos, 0
+                self.ship_batches += 1
+                self.ship_bytes += len(blob)
+                self.ship_wire_bytes += wire_len
+                self.heartbeat_meta["pos"] = int(newpos)
+                if not can_pipe:
+                    return
+                if newpos != end or self.role != "follower":
+                    # partial-record tail (or a role change mid-batch):
+                    # the in-flight request's from_pos no longer
+                    # matches our log — resync through a fresh
+                    # connection
+                    self._drop_link()
+                    return
+                self.ship_pipelined += 1
+                pos = end
+                try:
+                    reply = link._recv()
+                except RpcError:
+                    # typed verdict mid-stream (e.g. the primary
+                    # demoted): same stance as the head-of-loop case
+                    self._drop_link()
+                    self._stop.wait(0.2)
+                    return
+        finally:
+            if unsynced:
+                # close the deferred-fsync window on EVERY exit — the
+                # next handshake reports wal.tell() as its ack, which
+                # must not outrun durability
+                self.service._wal.sync()
 
     def _bootstrap(self, link: _PrimaryLink):
         """Install the primary's newest publish-consistent snapshot over
         the wire, then resume tailing its WAL suffix. When the primary
         has no snapshot but a complete log (base 0), fall back to the
         construction-time dataset partition and replay from 0."""
+        from euler_tpu.distributed import codec
         from euler_tpu.graph import wal as walmod
 
         to = float(os.environ.get("EULER_TPU_BOOTSTRAP_TIMEOUT_S", "60.0"))
         try:
             reply = link._call(
-                "wal_ship", [0, 0, self.rid, "snapshot"], timeout_s=to
+                "wal_ship",
+                [0, 0, self.rid, "snapshot", None, None, None,
+                 codec.wire_codec()],
+                timeout_s=to,
             )
         except RpcError:
             t, base, end, _ep = link._call("wal_pos", [])
@@ -534,13 +725,35 @@ class ReplicaCoordinator:
                 return
             raise
         term, epoch, wal_pos = int(reply[0]), int(reply[1]), int(reply[2])
-        applied = walmod._applied_from_blob(
-            bytes(np.ascontiguousarray(reply[3]))
-        )
-        names = json.loads(reply[4])
-        arrays = {
-            n: np.array(a, copy=True) for n, a in zip(names, reply[5:])
-        }
+        head = json.loads(reply[4])
+        if isinstance(head, dict):
+            # v2 (codec-aware) bootstrap: the header names the codec and
+            # each array's dtype/shape; blobs arrive framed+compressed.
+            # Any damage surfaces as ValueError before install.
+            use = str(head["codec"])
+            applied = walmod._applied_from_blob(
+                codec.decompress(
+                    use, bytes(np.ascontiguousarray(reply[3]))
+                )
+            )
+            arrays = {}
+            for n, dt, shape, blob in zip(
+                head["names"], head["dtypes"], head["shapes"], reply[5:]
+            ):
+                raw = codec.decompress(
+                    use, bytes(np.ascontiguousarray(blob))
+                )
+                arrays[n] = np.frombuffer(raw, np.dtype(dt)).reshape(
+                    shape
+                ).copy()
+        else:
+            # pre-codec primary: [.., applied_blob, names_json, *arrays]
+            applied = walmod._applied_from_blob(
+                bytes(np.ascontiguousarray(reply[3]))
+            )
+            arrays = {
+                n: np.array(a, copy=True) for n, a in zip(head, reply[5:])
+            }
         # install_snapshot writes a local snapshot through the full
         # write_snapshot commit discipline (per-file fsync + dir fsync +
         # atomic rename) BEFORE returning, so by the time the position

@@ -274,6 +274,9 @@ class _PoolServer:
         # relative ms (clocks are never compared); anchor it here, at
         # frame receipt, so queueing delay inside this server counts
         op, budget_ms = wire.unwrap_deadline(op)
+        counters = getattr(self.service, "wire_bytes_in", None)
+        if counters is not None:
+            counters[op] += 4 + len(payload)
         deadline = (
             time.monotonic() + budget_ms / 1e3
             if budget_ms is not None
@@ -345,6 +348,9 @@ class _PoolServer:
             for i in range(4, len(flat), max(1, len(flat) // 8)):
                 flat[i] ^= 0xFF
             frame = flat
+        counters = getattr(self.service, "wire_bytes_out", None)
+        if counters is not None:
+            counters[op] += wire.frame_nbytes(frame)
         return self._send(sock, frame)
 
     def _send(self, sock: socket.socket, frame) -> str:
@@ -451,6 +457,12 @@ class GraphService:
         # updates race benignly across pool workers — it is telemetry,
         # not an invariant.
         self.op_counts: collections.Counter = collections.Counter()
+        # per-verb wire byte counters (server side of the byte-budget
+        # story): _PoolServer adds 4+len(payload) on receipt and the
+        # encoded frame size on send. Same benign-race telemetry stance
+        # as op_counts.
+        self.wire_bytes_in: collections.Counter = collections.Counter()
+        self.wire_bytes_out: collections.Counter = collections.Counter()
         # replication (distributed/replication.py): with replica=,
         # this shard is one member of a replica group — a coordinator
         # runs the lease/tail/promotion state machine, mutations gate on
@@ -661,6 +673,11 @@ class GraphService:
                         "snapshots_quarantined", []
                     )
                 ),
+                # per-verb wire bytes (PR 16): what this server received
+                # / sent per op, counted at the socket seam. Old clients
+                # ignore the fields.
+                "wire_bytes_in": dict(self.wire_bytes_in),
+                "wire_bytes_out": dict(self.wire_bytes_out),
             })]
         if op == "scrub":
             # one synchronous at-rest integrity pass (graph/backup.py):
@@ -758,20 +775,34 @@ class GraphService:
                 w.append(np.asarray(c.w, np.float32)[idx])
                 tt.append(np.full(total, t, np.int32))
             if not row_pos:
-                return [
+                out = [
                     np.zeros(len(rows), np.int64),
                     np.empty(0, np.uint64),
                     np.empty(0, np.float32),
                     np.empty(0, np.int32),
                 ]
-            row_pos = np.concatenate(row_pos)
-            order = np.lexsort((np.concatenate(tt), row_pos))
-            return [
-                np.bincount(row_pos, minlength=len(rows)).astype(np.int64),
-                np.concatenate(dst)[order],
-                np.concatenate(w)[order],
-                np.concatenate(tt)[order],
-            ]
+            else:
+                row_pos = np.concatenate(row_pos)
+                order = np.lexsort((np.concatenate(tt), row_pos))
+                out = [
+                    np.bincount(row_pos, minlength=len(rows)).astype(
+                        np.int64
+                    ),
+                    np.concatenate(dst)[order],
+                    np.concatenate(w)[order],
+                    np.concatenate(tt)[order],
+                ]
+            if len(a) > 2 and a[2] == "delta":
+                # offered compact dst plane (PR 16): per-row sorted CSR
+                # runs delta-compress well. Exact after decode; old
+                # clients send 2 args and keep the raw u64 plane.
+                from euler_tpu.distributed import codec
+
+                out[1] = np.frombuffer(
+                    codec.encode_u64_delta(np.asarray(out[1], np.uint64)),
+                    np.uint8,
+                )
+            return out
         if op == "frontier_exchange":
             # boundary-vertex message reduction for the analytics BSP
             # step: (rows, keys, vals, mode) → per-row reduction in THE
@@ -841,8 +872,19 @@ class GraphService:
             out, w = dense_feature_udf(s, a[0], a[1], a[2])
             return [out, w]
         if op == "get_full_neighbor":
-            out = s.get_full_neighbor(a[0], a[1], a[2], a[3], a[4])
-            return list(out)
+            out = list(s.get_full_neighbor(a[0], a[1], a[2], a[3], a[4]))
+            if len(a) > 5 and a[5] == "delta":
+                # offered compact encoding (PR 16): the padded neighbor-id
+                # plane — mostly DEFAULT_ID and locally sorted runs —
+                # collapses under zigzag-delta varints. Exact after
+                # decode; old clients never send a[5].
+                from euler_tpu.distributed import codec
+
+                nbr = np.asarray(out[0], np.uint64)
+                out[0] = np.frombuffer(
+                    codec.encode_u64_delta(nbr.reshape(-1)), np.uint8
+                )
+            return out
         if op == "get_top_k_neighbor":
             return list(s.get_top_k_neighbor(a[0], a[1], a[2], a[3]))
         if op == "degree_sum":
@@ -852,9 +894,15 @@ class GraphService:
                 s.sample_neighbor_layerwise(a[0], a[1], a[2], _rng_from(a[3]))
             )
         if op == "get_dense_feature":
-            return [s.get_dense_feature(a[0], a[1])]
+            return self._quant_wire(
+                s.get_dense_feature(a[0], a[1]),
+                a[2] if len(a) > 2 else None,
+            )
         if op == "get_dense_by_rows":
-            return [s.get_dense_by_rows(np.asarray(a[0], np.int64), a[1])]
+            return self._quant_wire(
+                s.get_dense_by_rows(np.asarray(a[0], np.int64), a[1]),
+                a[2] if len(a) > 2 else None,
+            )
         if op == "get_sparse_feature":
             pairs = s.get_sparse_feature(a[0], a[1], a[2])
             return [x for pair in pairs for x in pair]
@@ -919,6 +967,22 @@ class GraphService:
             ]
         raise RuntimeError(
             f"op {op!r} is in HANDLED_VERBS but has no dispatch arm"
+        )
+
+    @staticmethod
+    def _quant_wire(vals, kind) -> list:
+        """Dense-feature reply under an OFFERED trailing wire dtype
+        (PR 16): "bf16" halves the payload (one bf16 array), "int8"
+        quarters it ([q u8, scale f32, lo f32] per-row affine). No
+        offer / "f32" keeps the exact single-f32-array reply old
+        clients expect. The error bound lives in codec.quant_error_
+        budget and is pinned in PARITY.md."""
+        if kind is None or str(kind) == "f32":
+            return [vals]
+        from euler_tpu.distributed import codec
+
+        return codec.quantize(
+            str(kind), np.asarray(vals, np.float32)
         )
 
     # -- streaming mutation (graph/delta.py) -----------------------------
@@ -1231,14 +1295,28 @@ class GraphService:
         need_snapshot=True when the prefix was trimmed, the follower is
         AHEAD of this log, or the tail checksum mismatches (divergent
         history — an ex-primary carrying never-replicated records)."""
+        from euler_tpu.distributed import codec
+
         from_pos = int(a[0])
         max_bytes = int(a[1]) if len(a) > 1 and a[1] is not None else 1 << 20
         rid = int(a[2]) if len(a) > 2 and a[2] is not None else None
         want = str(a[3]) if len(a) > 3 and a[3] is not None else "log"
+        # trailing PR-16 args (old clients simply omit them): a[7] is the
+        # follower's codec OFFER, a[8] its explicit durable-ack position
+        # — a pipelined follower's speculative from_pos runs AHEAD of its
+        # fsync, so the ack must travel separately or quorum accounting
+        # would count unfsync'd bytes
+        offer = str(a[7]) if len(a) > 7 and a[7] is not None else None
+        use = (
+            offer
+            if offer in codec.available_codecs()
+            else (codec.IDENTITY if offer is not None else None)
+        )
+        ack_pos = int(a[8]) if len(a) > 8 and a[8] is not None else from_pos
         if rid is not None and self._repl is not None:
-            self._repl.note_follower(rid, from_pos)
+            self._repl.note_follower(rid, ack_pos)
         if want == "snapshot":
-            return self._ship_snapshot()
+            return self._ship_snapshot(use)
         if self._wal is None:
             raise RpcError("wal_ship: this shard has no WAL (wal_dir)")
         term = int(self._repl.term) if self._repl is not None else 0
@@ -1255,26 +1333,64 @@ class GraphService:
             except ValueError:
                 pass  # window partially trimmed here: snapshot covers it
         if need:
-            return [term, np.empty(0, np.uint8), from_pos, True]
+            out = [term, np.empty(0, np.uint8), from_pos, True]
+            if use is not None:
+                out += [codec.IDENTITY, 0, int(self._wal.tell())]
+            return out
         data, end = self._wal.read_raw(from_pos, max_bytes)
         if not data and poll_ms > 0 and self._repl is not None:
             # server-side long poll: wait briefly for the next commit so
             # follower lag (and quorum ack latency) is ~one RTT + fsync,
-            # not a client polling interval
-            self._repl.wait_for_append(from_pos, poll_ms / 1e3)
-            data, end = self._wal.read_raw(from_pos, max_bytes)
+            # not a client polling interval. EXCEPT when a quorum
+            # committer is already parked waiting for an ack newer than
+            # this request carried — then answer empty at once so the
+            # (pipelined) follower can come back with a fresh ack
+            # instead of stalling the commit a full poll interval.
+            if rid is None or not self._repl.ack_wanted(ack_pos):
+                self._repl.wait_for_append(from_pos, poll_ms / 1e3)
+                data, end = self._wal.read_raw(from_pos, max_bytes)
+        if use is None:
+            # old client: raw 4-tuple, byte-identical to the pre-codec
+            # reply (a fifth item would still be ignored, but keeping the
+            # shape pinned is what the degrade tests assert)
+            return [
+                term,
+                np.frombuffer(data, np.uint8)
+                if data
+                else np.empty(0, np.uint8),
+                int(end),
+                False,
+            ]
+        # new shape: [.., codec, raw_len, log_end] — log_end tells the
+        # follower whether more records are pending behind this batch
+        # (throughput mode: overlap + deferred fsync) or it is caught up
+        # (latency mode: fsync, then park a fresh-ack request). Tiny
+        # batches (steady-state commit tailing) skip compression: the
+        # codec rides in the reply, so the choice is per-batch, and
+        # putting zlib on a ~2KB commit's serial path only adds latency
+        if len(data) < 4096:
+            use = codec.IDENTITY
+        blob = codec.compress(use, data) if data else b""
         return [
             term,
-            np.frombuffer(data, np.uint8) if data else np.empty(0, np.uint8),
+            np.frombuffer(blob, np.uint8) if blob else np.empty(0, np.uint8),
             int(end),
             False,
+            use,
+            len(data),
+            int(self._wal.tell()),
         ]
 
-    def _ship_snapshot(self) -> list:
+    def _ship_snapshot(self, use: str | None = None) -> list:
         """Bootstrap payload: [term, epoch, wal_pos, applied_blob(u8),
         names_json, *arrays] — the newest publish-consistent state (the
         in-memory _snap_state when one exists, else the newest on-disk
-        snapshot)."""
+        snapshot). When the follower offered a codec (`use` is not
+        None), item 4 becomes a v2 JSON header dict and the applied
+        blob plus every array ship as compressed u8 blobs — bootstrap
+        is the single largest transfer in the system and compresses
+        well (sorted ids, zero-padded planes)."""
+        from euler_tpu.distributed import codec
         from euler_tpu.graph import wal as walmod
 
         term = int(self._repl.term) if self._repl is not None else 0
@@ -1296,18 +1412,44 @@ class GraphService:
             epoch = int(epoch)
         names = sorted(arrays)
         blob = bytes(walmod._applied_blob(applied))
+        if use is None:
+            return [
+                term, epoch, int(pos),
+                np.frombuffer(blob, np.uint8),
+                json.dumps(names),
+            ] + [np.ascontiguousarray(arrays[n]) for n in names]
+        mats = [np.ascontiguousarray(arrays[n]) for n in names]
+        head = {
+            "v": 2,
+            "codec": use,
+            "names": names,
+            "dtypes": [m.dtype.str for m in mats],
+            "shapes": [list(m.shape) for m in mats],
+        }
         return [
             term, epoch, int(pos),
-            np.frombuffer(blob, np.uint8),
-            json.dumps(names),
-        ] + [np.ascontiguousarray(arrays[n]) for n in names]
+            np.frombuffer(codec.compress(use, blob), np.uint8),
+            json.dumps(head),
+        ] + [
+            np.frombuffer(codec.compress(use, m.tobytes()), np.uint8)
+            for m in mats
+        ]
 
-    def apply_shipped(self, data: bytes, from_pos: int) -> int:
+    def apply_shipped(
+        self, data: bytes, from_pos: int, durable: bool = True,
+        acked=None,
+    ) -> int:
         """Follower apply: verbatim-append a shipped record suffix and
         replay it through the SAME staging/merge code the primary ran —
         byte-identical logs and deterministic merges make every replica
         bit-identical by construction. Returns the new durable position
-        (the implicit ack the next ship request carries)."""
+        (the implicit ack the next ship request carries). durable=False
+        defers the fsync (pipelined catch-up streaming); the caller must
+        wal-sync() before advancing its reported ack. `acked(end)` fires
+        right after the durable append, BEFORE the staging replay —
+        durability is what a quorum ack certifies, so the shipper sends
+        the ack with the replay still pending (it must not raise; the
+        replay runs regardless)."""
         from euler_tpu.graph import wal as walmod
 
         records, valid_end = walmod.parse_records(data, from_pos)
@@ -1324,7 +1466,9 @@ class GraphService:
                 )
             # durable FIRST (fsync inside), apply second: a crash
             # mid-apply replays the appended records from our own WAL
-            self._wal.append_raw(blob)
+            self._wal.append_raw(blob, durable=durable)
+            if acked is not None:
+                acked(valid_end)
             for op, a, end, _term in records:
                 if op == "publish_epoch":
                     key = a[0] if a else None

@@ -125,19 +125,26 @@ def _unpack_value(view: memoryview, off: int, borrow: bool = False):
     if tag == 0:
         code, ndim = struct.unpack_from("<BB", view, off)
         off += 2
-        shape = []
-        for _ in range(ndim):
-            (d,) = struct.unpack_from("<q", view, off)
-            off += 8
-            shape.append(d)
+        # hot path (every array of every RPC and WAL record): one
+        # unpack for all dims, plain-int product (np.prod dominated
+        # decode cost), and no frombuffer/copy churn for empty arrays
+        if ndim:
+            shape = struct.unpack_from("<%dq" % ndim, view, off)
+            off += 8 * ndim
+            n = 1
+            for d in shape:
+                n *= d
+        else:
+            shape, n = (), 1
         dt = _CODE_DTYPES[code]
-        n = int(np.prod(shape)) if shape else 1
         nbytes = dt.itemsize * n
-        arr = np.frombuffer(view[off : off + nbytes], dtype=dt).reshape(
-            shape
-        )
+        if n == 0:
+            return np.empty(shape, dt), off + nbytes
+        arr = np.frombuffer(view[off : off + nbytes], dtype=dt)
         if not borrow:
             arr = arr.copy()
+        if ndim != 1:
+            arr = arr.reshape(shape)
         return arr, off + nbytes
     if tag == 1:
         (v,) = struct.unpack_from("<q", view, off)
@@ -223,6 +230,15 @@ def _decode(payload, borrow: bool) -> tuple[str, list]:
         v, off = _unpack_value(view, off, borrow)
         values.append(v)
     return op, values
+
+
+def frame_nbytes(data) -> int:
+    """Total wire bytes of one frame — flat buffer or `encode_vectored`
+    part list (the per-verb bytes_in/bytes_out counter seam; counting
+    here keeps the zero-copy send path free of a join)."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return len(data)
+    return sum(len(p) for p in data)
 
 
 def read_frame(sock: socket.socket) -> bytearray | None:

@@ -39,18 +39,45 @@ class DeviceFeatureCache:
         dtype=jnp.float32,
         sharding=None,
         stage_chunk_rows: int | None = None,
+        quant: str | None = None,
     ):
         """stage_chunk_rows: stage the table onto the device in row chunks
         instead of one transfer — big tables (hundreds of MB) shipped as a
         single device_put can trip transport limits on proxied/tunneled
-        devices; chunking bounds each transfer."""
+        devices; chunking bounds each transfer.
+
+        quant: HBM page dtype — "f32" (exact, the default), "bf16" (half
+        the HBM, one rounding per value), or "int8" (quarter the HBM,
+        per-row affine scale/zero-point) — defaults to the
+        EULER_TPU_PAGE_DTYPE env knob. Dequantize happens inside
+        `gather`, where XLA fuses it with the first layer's matmul; the
+        error budget per dtype is pinned in PARITY.md and enforced by
+        tests. Explicit non-f32 `dtype` wins over `quant` (the caller
+        already chose a representation)."""
+        from euler_tpu.distributed.codec import page_dtype, quantize
+
         self.feature_names = list(feature_names)
         host = graph.dense_feature_table(self.feature_names)
         self.dim = host.shape[1]
         table = np.concatenate(
             [np.zeros((1, self.dim), np.float32), host], axis=0
         )
-        table = table.astype(np.dtype(dtype))
+        self.quant = (
+            (quant if quant is not None else page_dtype())
+            if np.dtype(dtype) == np.float32
+            else "f32"
+        )
+        if self.quant == "int8":
+            q, scale, zero = quantize("int8", table)
+            # padding row 0 dequantizes to exact zeros: q=0, zero=0
+            zero[0] = 0.0
+            self._scale = jax.device_put(scale)
+            self._zero = jax.device_put(zero)
+            table = q
+        elif self.quant == "bf16":
+            table = table.astype(jnp.bfloat16)
+        else:
+            table = table.astype(np.dtype(dtype))
         if stage_chunk_rows and len(table) > stage_chunk_rows:
             put = (
                 (lambda a: jax.device_put(a, sharding))
@@ -72,8 +99,34 @@ class DeviceFeatureCache:
             )
 
     def gather(self, rows) -> jnp.ndarray:
-        """int32 rows (0 = padding) → dense [n, F]; jit-safe."""
+        """int32 rows (0 = padding) → dense [n, F]; jit-safe. Quantized
+        tables dequantize here — next to the consuming matmul, so XLA
+        fuses it and the host/HBM copies stay compact."""
+        if self.quant == "int8":
+            q = self.table[rows].astype(jnp.float32)
+            return q * self._scale[rows][..., None] + (
+                self._zero[rows][..., None]
+            )
+        if self.quant == "bf16":
+            return self.table[rows].astype(jnp.float32)
         return self.table[rows]
+
+    def _patch(self, rows, vals) -> None:
+        """Write f32 values into table rows (row+1 space already applied
+        by the caller), re-quantizing to the table's representation."""
+        from euler_tpu.distributed.codec import quantize
+
+        if self.quant == "int8":
+            q, scale, zero = quantize(
+                "int8", np.asarray(vals, np.float32)
+            )
+            self.table = self.table.at[rows].set(jnp.asarray(q))
+            self._scale = self._scale.at[rows].set(jnp.asarray(scale))
+            self._zero = self._zero.at[rows].set(jnp.asarray(zero))
+            return
+        self.table = self.table.at[rows].set(
+            jnp.asarray(vals, dtype=self.table.dtype)
+        )
 
     def refresh_rows(self, graph, rows) -> int:
         """Residual re-staging: refetch ONLY the given global rows and
@@ -90,9 +143,7 @@ class DeviceFeatureCache:
         vals = np.asarray(
             graph.get_dense_by_rows(rows, self.feature_names), np.float32
         )
-        self.table = self.table.at[rows + 1].set(
-            jnp.asarray(vals, dtype=self.table.dtype)
-        )
+        self._patch(rows + 1, vals)
         return int(len(rows))
 
     def hydrate(self, batch):
@@ -281,9 +332,7 @@ class ResidualFetchRing:
             if isinstance(vals, Exception):
                 err = err or vals
                 continue
-            self.cache.table = self.cache.table.at[rows + 1].set(
-                jnp.asarray(vals, dtype=self.cache.table.dtype)
-            )
+            self.cache._patch(rows + 1, vals)
             n += len(rows)
         if n:
             self.commits += 1

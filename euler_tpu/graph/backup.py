@@ -70,6 +70,69 @@ ARCHIVE_MANIFEST = "manifest.json"
 ARCHIVE_VERSION = 1
 
 
+def archive_codec() -> str:
+    """EULER_TPU_BACKUP_CODEC: stream codec archived files are stored
+    under ("id" default — archives stay byte-identical to PR 15's;
+    "zlib"/"zstd" shrink them under the distributed/codec.py seam).
+    The manifest records the codec, so restore of either kind is
+    automatic."""
+    from euler_tpu.distributed import codec as codecmod
+
+    name = os.environ.get("EULER_TPU_BACKUP_CODEC", "id").strip() or "id"
+    return name if name in codecmod.available_codecs() else codecmod.IDENTITY
+
+
+def _compress_tree(base_dir: str, name: str) -> None:
+    """Rewrite every file under base_dir as a framed compressed blob
+    (same relative paths — manifest crcs then cover the STORED bytes,
+    so verify_archive needs no codec awareness)."""
+    from euler_tpu.distributed import codec as codecmod
+
+    for root, _dirs, files in os.walk(base_dir):
+        for fn in files:
+            p = os.path.join(root, fn)
+            with open(p, "rb") as f:
+                raw = f.read()
+            blob = codecmod.compress(name, raw)
+            with open(p, "wb") as f:
+                f.write(blob)
+
+
+def _explode_archive(archive_dir: str, manifest: dict, out: str) -> None:
+    """Decompress a codec'd archive's payload files into `out` (same
+    layout) so the restore path reads plain bytes. Each file's codec
+    frame (raw length + crc) is checked during decompression — damage
+    raises ValueError instead of restoring garbage."""
+    from euler_tpu.distributed import codec as codecmod
+
+    use = manifest.get("codec", codecmod.IDENTITY)
+
+    def explode(src_base: str, files: dict, dst_base: str) -> None:
+        for rel in sorted(files):
+            src = os.path.join(src_base, rel)
+            dst = os.path.join(dst_base, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            with open(src, "rb") as f:
+                blob = f.read()
+            with open(dst, "wb") as f:
+                f.write(codecmod.decompress(use, blob))
+
+    for sid in manifest["shards"]:
+        entry = manifest["shards"][sid]
+        explode(
+            os.path.join(archive_dir, f"shard_{int(sid)}"),
+            entry["files"],
+            os.path.join(out, f"shard_{int(sid)}"),
+        )
+    tr = manifest.get("trainer")
+    if tr:
+        explode(
+            os.path.join(archive_dir, "trainer", tr["checkpoint"]),
+            tr["files"],
+            os.path.join(out, "trainer", tr["checkpoint"]),
+        )
+
+
 def scrub_cadence_s() -> float:
     """EULER_TPU_SCRUB_S: background integrity-scrub cadence in seconds
     (0 = off, the default — operators and the supervisor opt in)."""
@@ -249,11 +312,15 @@ def backup_cluster(
     if os.path.exists(tmp):
         shutil.rmtree(tmp)
     os.makedirs(tmp)
+    use = archive_codec()
     manifest: dict = {
         "version": ARCHIVE_VERSION,
         "created_ts": time.time(),
         "num_shards": num_shards,
         "data_dir": data_dir,
+        # how payload files are STORED ("id" = plain bytes, the PR 15
+        # format; restore reads this, operators never pass it)
+        "codec": use,
         "shards": {},
         "trainer": None,
     }
@@ -302,6 +369,11 @@ def backup_cluster(
         tl = epoch_timeline(
             [r for r in records if r[2] > p0], e0, applied0, sid, num_shards
         )
+        if use != "id":
+            # compress AFTER every content read above; the manifest crcs
+            # below then cover the stored (compressed) bytes, keeping
+            # verify_archive codec-blind
+            _compress_tree(dst, use)
         manifest["shards"][str(int(sid))] = {
             "wal_base": int(base),
             "wal_end": int(valid_end),
@@ -317,6 +389,8 @@ def backup_cluster(
         if ck is not None:
             dst = os.path.join(tmp, "trainer", os.path.basename(ck))
             shutil.copytree(ck, dst)
+            if use != "id":
+                _compress_tree(dst, use)
             manifest["trainer"] = {
                 "checkpoint": os.path.basename(ck),
                 "files": _crc_walk(dst),
@@ -445,6 +519,41 @@ def restore_cluster(
             f" {v['bad_files'][:8]}"
         )
     manifest = v["manifest"]
+    use = manifest.get("codec", "id")
+    exploded = None
+    if use != "id":
+        # codec'd archive: decompress payload files to a scratch mirror
+        # first (each file's codec frame crc re-checked in the process)
+        # and restore from THAT — the logic below then never needs to
+        # know the archive was compressed
+        import tempfile
+
+        exploded = tempfile.mkdtemp(prefix="euler_restore_")
+        _explode_archive(archive_dir, manifest, exploded)
+    try:
+        return _restore_verified(
+            archive_dir if exploded is None else exploded,
+            manifest, out_root, epoch, replication, model_dir,
+            stored_crcs=exploded is None,
+        )
+    finally:
+        if exploded is not None:
+            shutil.rmtree(exploded, ignore_errors=True)
+
+
+def _restore_verified(
+    archive_dir: str,
+    manifest: dict,
+    out_root: str,
+    epoch: int | None,
+    replication: int,
+    model_dir: str | None,
+    stored_crcs: bool,
+) -> dict:
+    """restore_cluster's body against an already-verified plain-bytes
+    archive view. `stored_crcs` is False for the decompressed mirror of
+    a codec'd archive (manifest crcs cover the stored blobs, and the
+    codec frames already re-checked the raw bytes)."""
     num_shards = int(manifest["num_shards"])
     replication = max(1, int(replication))
     report: dict = {
@@ -461,7 +570,10 @@ def restore_cluster(
         src = os.path.join(archive_dir, f"shard_{sid}")
         wal_src = os.path.join(src, walmod.WAL_FILE)
         records, base, valid_end = read_archive_wal(
-            wal_src, expect_crc=entry["files"][walmod.WAL_FILE]
+            wal_src,
+            expect_crc=(
+                entry["files"][walmod.WAL_FILE] if stored_crcs else None
+            ),
         )
         cand = [
             c for c in _start_candidates(src, entry.get("snapshots", []), base)

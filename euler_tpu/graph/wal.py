@@ -277,12 +277,14 @@ class WriteAheadLog:
                 blob = f.read(to_logical - from_logical)
         return zlib.crc32(blob) & 0xFFFFFFFF
 
-    def append_raw(self, data: bytes) -> int:
+    def append_raw(self, data: bytes, durable: bool = True) -> int:
         """Append already-encoded records verbatim (a follower applying a
         shipped suffix — the caller validated record integrity by parsing
         first) and fsync them per the fsync mode. Byte-identical appends
         keep every replica's logical offsets interchangeable. Returns the
-        new end logical offset."""
+        new end logical offset. durable=False skips the fsync — a
+        pipelined follower streaming a catch-up backlog defers it and
+        calls sync() before advancing its reported durable ack."""
         if not data:
             return self.tell()
         with self._lock:
@@ -292,8 +294,16 @@ class WriteAheadLog:
             self._written_seq += 1
             self.records_written += 1
             seq, end = self._written_seq, self.base + self._size
-        self.commit(seq)
+        if durable:
+            self.commit(seq)
         return end
+
+    def sync(self) -> None:
+        """Make everything written so far durable (per the fsync mode) —
+        closes a durable=False append_raw window."""
+        with self._lock:
+            seq = self._written_seq
+        self.commit(seq)
 
     def reset(self, base_logical: int) -> None:
         """Drop every record and restart the log at `base_logical` — a
@@ -432,14 +442,15 @@ def parse_records(
     the replication follower (validating a shipped suffix before the
     verbatim `append_raw`)."""
     records: list[tuple[str, list, int, int]] = []
+    view = memoryview(blob)  # per-record slices stay views, not copies
     off = 0
     valid = 0
     while off + _REC.size <= len(blob):
-        n, crc = _REC.unpack_from(blob, off)
+        n, crc = _REC.unpack_from(view, off)
         start = off + _REC.size
         if start + n > len(blob):
             break  # torn tail: length prefix written, payload cut short
-        payload = blob[start : start + n]
+        payload = view[start : start + n]
         if zlib.crc32(payload) != crc:
             break  # corrupt (or a torn length field pointing at garbage)
         try:

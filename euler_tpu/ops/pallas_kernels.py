@@ -290,6 +290,110 @@ def paged_gather(table2d, fidx, impl: str = "auto"):
     return _paged_gather_pallas(table2d, fidx, interpret=(impl == "interpret"))
 
 
+def pack_bf16_words(flat):
+    """f32 1-D buffer → uint32 words, two bf16 values per word (low half
+    = even index, high half = odd). This keeps quantized feature pages in
+    the SAME 4-byte lane-row shape the validated DMA path uses — bf16's
+    native (16, 128) min tile never enters the kernel; the u32 word is
+    split after the lane select. bf16 here is truncation-free f32
+    prefixes, so unpack (<< 16 + bitcast) is exact bf16 → f32."""
+    flat = jnp.asarray(flat).reshape(-1)
+    u16 = jax.lax.bitcast_convert_type(
+        flat.astype(jnp.bfloat16), jnp.uint16
+    ).astype(jnp.uint32)
+    if u16.shape[0] % 2:
+        u16 = jnp.pad(u16, (0, 1))
+    pair = u16.reshape(-1, 2)
+    return pair[:, 0] | (pair[:, 1] << 16)
+
+
+def _unpack_bf16_word(word, odd):
+    # select the half, re-widen to f32 by shifting into the high bits —
+    # bf16 is a truncated f32, so this is the exact inverse of the pack
+    half = jnp.where(odd, word >> 16, word) & 0xFFFF
+    return jax.lax.bitcast_convert_type(
+        (half << 16).astype(jnp.uint32), jnp.float32
+    )
+
+
+def _paged_gather_dequant_kernel(k, table_ref, fidx_ref, out_ref, scratch,
+                                 sems):
+    # same DMA/iota-select shape as _paged_gather_kernel, but fidx is a
+    # logical bf16 element index: the holding u32 word sits at fidx // 2,
+    # and the selected word is unpacked in-kernel (the RPA playbook:
+    # compact pages in HBM, pay decode next to the gather, not on host).
+    def copies(i, buf):
+        for j in range(k):
+            yield pltpu.make_async_copy(
+                table_ref.at[(fidx_ref[i, j] // 2) // PAGE_LANES],
+                scratch.at[buf, j],
+                sems.at[buf, j],
+            )
+
+    start = lambda i, buf: [cp.start() for cp in copies(i, buf)]  # noqa: E731
+    wait = lambda i, buf: [cp.wait() for cp in copies(i, buf)]  # noqa: E731
+
+    start(0, 0)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (1, PAGE_LANES), 1)
+    for i in range(TILE):
+        if i + 1 < TILE:
+            start(i + 1, (i + 1) % 2)
+        wait(i, i % 2)
+        vals = []
+        for j in range(k):
+            lane = (fidx_ref[i, j] // 2) % PAGE_LANES
+            row = scratch[i % 2, j].reshape(1, PAGE_LANES)
+            word = jnp.sum(jnp.where(lanes == lane, row, 0))
+            vals.append(_unpack_bf16_word(word, fidx_ref[i, j] % 2 == 1))
+        out_ref[i, :] = jnp.stack(vals)
+
+
+def _paged_gather_dequant_pallas(table2d, fidx, interpret: bool):
+    n, k = fidx.shape
+    pad = (-n) % TILE
+    if pad:
+        fidx = jnp.pad(fidx, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_paged_gather_dequant_kernel, k),
+        grid=(fidx.shape[0] // TILE,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # packed pages stay in HBM
+            pl.BlockSpec(
+                (TILE, k), lambda i: (i, 0), memory_space=pltpu.SMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE, k), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((fidx.shape[0], k), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, k, PAGE_LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2, k)),
+        ],
+        interpret=interpret,
+    )(table2d, fidx.astype(jnp.int32))
+    return out[:n]
+
+
+def paged_gather_dequant(table2d, fidx, impl: str = "auto"):
+    """out[i, j] = bf16_unpack(flat(table2d))[fidx[i, j]] as f32 — the
+    quantized-page twin of `paged_gather`. `table2d` is a [M, 128]
+    lane-row view of a `pack_bf16_words` buffer (uint32, two bf16 per
+    word); `fidx` indexes LOGICAL bf16 elements. Dequantize happens at
+    the gather (in-kernel for 'pallas'), so HBM and DMA bytes are half
+    the f32 path. Same impl discipline as paged_gather: 'auto' → the
+    jitted jnp reference; the Pallas form is interpret-validated."""
+    impl = _paged_impl(impl)
+    fidx = fidx.astype(jnp.int32)
+    if impl == "xla":
+        flat = table2d.reshape(-1)
+        word = flat[fidx // 2]
+        return _unpack_bf16_word(word, fidx % 2 == 1)
+    return _paged_gather_dequant_pallas(
+        table2d, fidx, interpret=(impl == "interpret")
+    )
+
+
 def _paged_count_kernel(k, page_size, q_ref, page_ref, r_ref, out_ref,
                         scratch, sems):
     # per (row i, draw j): DMA the lane row holding page page_ref[i, j]

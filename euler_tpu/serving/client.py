@@ -123,8 +123,13 @@ class ServingClient:
 
     def stats(self) -> dict:
         """server_stats from ONE replica (whichever the pool rotates
-        onto) — fleet_stats() for the whole fleet."""
-        return json.loads(self._call("server_stats", [])[0])
+        onto) — fleet_stats() for the whole fleet. The reply carries the
+        server's per-verb wire_bytes_in/out; this handle's own counters
+        ride along under client_wire_bytes_*."""
+        out = json.loads(self._call("server_stats", [])[0])
+        out["client_wire_bytes_out"] = dict(self._pool.wire_bytes_out)
+        out["client_wire_bytes_in"] = dict(self._pool.wire_bytes_in)
+        return out
 
     def fleet_stats(self, timeout_s: float = 2.0) -> dict:
         """server_stats from EVERY replica, keyed "host:port";

@@ -32,6 +32,7 @@ rows go through the LIVE batcher (the exact served path) so the returned
 
 from __future__ import annotations
 
+import collections
 import json
 import time
 
@@ -84,6 +85,11 @@ class ModelServer:
         self.shard = shard
         self._beat = None
         self._started = time.monotonic()
+        # per-verb wire byte counters, filled by _PoolServer at the
+        # socket seam (same telemetry stance as the graph service);
+        # surfaced through server_stats -> fleet_stats
+        self.wire_bytes_in: collections.Counter = collections.Counter()
+        self.wire_bytes_out: collections.Counter = collections.Counter()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -144,6 +150,8 @@ class ModelServer:
                 buckets=list(getattr(self.runtime, "buckets", ())),
                 reloads=getattr(self.runtime, "reloads", 0),
                 uptime_s=round(time.monotonic() - self._started, 3),
+                wire_bytes_in=dict(self.wire_bytes_in),
+                wire_bytes_out=dict(self.wire_bytes_out),
             )
             durability = self._graph_durability()
             if durability is not None:

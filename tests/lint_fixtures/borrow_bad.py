@@ -1,0 +1,35 @@
+"""known-bad: borrow-mode decoded views escaping their recv frame.
+
+Every store below keeps a numpy slice of the frame buffer alive past
+the call — the cache entry pins the whole multi-MB frame.
+"""
+
+import wire  # stand-in for euler_tpu.distributed.wire
+
+_FRAME_MEMO = {}
+
+
+class RowCacheLeak:
+    def __init__(self):
+        self._rows = {}
+        self._pending = []
+        self._last = None
+
+    def fetch(self, sock, key):
+        payload = wire.read_frame(sock)
+        op, values = wire.decode(payload, borrow=True)
+        block = values[0]
+        # BAD: the cached row is a view — the dict entry pins the frame
+        self._rows[key] = block
+        # BAD: the attribute keeps every decoded view of this frame
+        self._last = values
+        return op
+
+    def fetch_rows(self, sock, ids):
+        _, vals = wire.decode(wire.read_frame(sock), borrow=True)
+        for i in ids:
+            # BAD: module-global memo retains a row view per distinct id
+            _FRAME_MEMO.setdefault(i, vals[0][i])
+        # BAD: append retains the first row's view on the instance
+        self._pending.append(vals[0][0])
+        return len(ids)

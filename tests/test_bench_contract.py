@@ -160,6 +160,34 @@ def test_bench_smoke_emits_final_json_line():
     assert row["dr_restore_to_first_read_ms"] > 0
     assert row["dr_scrub_mb_per_sec"] > 0
     assert row["dr_read_rate_scrub_over_idle"] > 0
+    # the byte-budget lane (ISSUE 16) must not silently vanish
+    # (EULER_BENCH_BYTES=0 is the opt-out — default is on): quantized
+    # dense wire A/B, warm-cache residency, delta-coded neighbor
+    # planes, and the compressed + pipelined wal_ship A/B all ride the
+    # artifact
+    assert row["bytes"] is True, row
+    assert row["bytes_dense_f32_per_batch"] > 0
+    assert row["bytes_dense_bf16_per_batch"] > 0
+    assert row["bytes_dense_int8_per_batch"] > 0
+    # bf16 pages halve every dense payload; headers are noise at any
+    # batch size, so the wire reduction holds even in smoke
+    assert row["bytes_dense_reduction_pct"] >= 40, row
+    # quantization error must be nonzero (it IS lossy) yet inside the
+    # pinned per-row bf16 budget (PARITY.md)
+    assert 0 < row["bytes_dense_bf16_max_err"] < 0.05, row
+    assert row["bytes_warm_cache_saved_pct"] > 0, row
+    # delta + varint must beat raw u64 planes on sorted neighbor ids
+    assert row["bytes_full_nb_delta"] < row["bytes_full_nb_raw"], row
+    # the wal_ship A/B: both codec legs measured in the same run
+    assert row["bytes_catchup_mb_per_sec_id"] > 0
+    assert row["bytes_catchup_mb_per_sec_zlib"] > 0
+    assert row["bytes_quorum_overhead_x_id"] >= 0.8, row
+    assert row["bytes_quorum_overhead_x_zlib"] >= 0.8, row
+    # shipping WAL batches must actually compress...
+    assert row["bytes_ship_compression_ratio"] > 1.5, row
+    # ...and the follower must actually overlap apply with the next
+    # fetch (speculative requests answered, not lockstep)
+    assert row["bytes_ship_pipelined_batches"] >= 1, row
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline

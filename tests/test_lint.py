@@ -122,6 +122,11 @@ def test_gate_covers_the_package():
         # wire protocol — both checker territories
         "euler_tpu/graph/backup.py",
         "euler_tpu/tools/backup.py",
+        # the byte-budget lane (ISSUE 16): the frame codec every
+        # compressed stream rides, plus the borrow-mode decode paths the
+        # borrowed-buffer-escape checker audits
+        "euler_tpu/distributed/codec.py",
+        "euler_tpu/distributed/wire.py",
         "bench.py",
     ):
         assert must in rels, f"{must} escaped the lint gate"
@@ -215,6 +220,33 @@ def test_durable_write_fixed_form_clean():
     assert _check(
         _fixture_project("durable_good.py"), "durable-write"
     ) == []
+
+
+def test_borrowed_buffer_escape_fixture_trips():
+    findings = _check(
+        _fixture_project("borrow_bad.py"), "borrowed-buffer-escape"
+    )
+    ids = _ids(findings)
+    assert ids["borrowed-buffer-escape"] == 4, findings
+    # the cache-store, the attribute retain, the module-global memo, and
+    # the append of a row view are all distinct escape shapes
+    messages = sorted(f.message.split(" — ")[0] for f in findings)
+    assert any("self._rows" in m for m in messages), messages
+    assert any("self._last" in m for m in messages), messages
+    assert any("_FRAME_MEMO" in m for m in messages), messages
+    assert any("self._pending" in m for m in messages), messages
+
+
+def test_borrowed_buffer_escape_fixed_form_clean():
+    # borrow_good.py mirrors the shipped idiom: copy exactly the rows
+    # kept (per-row tobytes, .copy(), np.array) before any store;
+    # locals-only views are the fast path and stay unflagged
+    assert (
+        _check(
+            _fixture_project("borrow_good.py"), "borrowed-buffer-escape"
+        )
+        == []
+    )
 
 
 def test_determinism_fixture_trips():
