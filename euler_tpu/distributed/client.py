@@ -214,6 +214,12 @@ class _RemoteCondition:
         self.total_weight = total_weight
 
 
+class _DenseWireDegraded(Exception):
+    """A quantized dense fetch hit a pre-codec replica mid-cache-fetch:
+    the batch must be redone on the exact f32 keyspace so the quantized
+    cache key never mixes 1-part f32 and 3-part int8 block shapes."""
+
+
 class RemoteShard:
     """GraphStore-compatible view of one shard served by N replicas."""
 
@@ -1022,7 +1028,10 @@ class RemoteShard:
             return (base, tuple(names))
         return (base, tuple(names), kind)
 
-    def _dense_miss(self, verb: str, miss, names: list, kind: str) -> list:
+    def _dense_miss(
+        self, verb: str, miss, names: list, kind: str,
+        strict: bool = False,
+    ) -> list:
         out = self.call(verb, [miss, names, kind])
         if len(out) == 1 and np.asarray(out[0]).dtype == np.float32:
             # a server predating the trailing wire-dtype arg ignored it
@@ -1030,6 +1039,15 @@ class RemoteShard:
             # keep the reply verbatim — bit-identical old behavior,
             # never a client-side re-quantization
             self._dense_wire = False
+            if strict and kind != "f32":
+                # mid-cache-fetch degrade (rolling upgrade: this miss
+                # hit an old replica while the quantized key may hold
+                # 3-part blocks from a new one): the 1-part block must
+                # NOT enter the quantized keyspace — cache.fetch would
+                # later assemble mixed tuple shapes. Abort before the
+                # cache registers anything; the caller redoes the batch
+                # on the exact f32 key.
+                raise _DenseWireDegraded(verb)
         return out
 
     @staticmethod
@@ -1064,13 +1082,25 @@ class RemoteShard:
             )[0]
         # the cache stores QUANTIZED blocks (that is the warm-cache byte
         # saving); dequantize after assembly, per fetch
-        parts = c.fetch(
-            self._dense_key("dense", names, kind),
-            ids,
-            lambda miss: self._dense_miss(
-                "get_dense_feature", miss, list(names), kind
-            ),
-        )
+        try:
+            parts = c.fetch(
+                self._dense_key("dense", names, kind),
+                ids,
+                lambda miss: self._dense_miss(
+                    "get_dense_feature", miss, list(names), kind,
+                    strict=True,
+                ),
+            )
+        except _DenseWireDegraded:
+            # an old replica answered mid-fetch (sticky downgrade just
+            # landed): redo the whole batch on the exact f32 key
+            return c.fetch(
+                ("dense", tuple(names)),
+                ids,
+                lambda miss: [
+                    self.call("get_dense_feature", [miss, list(names)])[0]
+                ],
+            )[0]
         return self._dense_decode(kind, parts)
 
     def get_dense_by_rows(self, rows, names):
@@ -1093,13 +1123,23 @@ class RemoteShard:
                     self.call("get_dense_by_rows", [miss, list(names)])[0]
                 ],
             )[0]
-        parts = c.fetch(
-            self._dense_key("dense_rows", names, kind),
-            rows,
-            lambda miss: self._dense_miss(
-                "get_dense_by_rows", miss, list(names), kind
-            ),
-        )
+        try:
+            parts = c.fetch(
+                self._dense_key("dense_rows", names, kind),
+                rows,
+                lambda miss: self._dense_miss(
+                    "get_dense_by_rows", miss, list(names), kind,
+                    strict=True,
+                ),
+            )
+        except _DenseWireDegraded:
+            return c.fetch(
+                ("dense_rows", tuple(names)),
+                rows,
+                lambda miss: [
+                    self.call("get_dense_by_rows", [miss, list(names)])[0]
+                ],
+            )[0]
         return self._dense_decode(kind, parts)
 
     def get_dense_feature_udf(self, ids, names, udfs):

@@ -156,6 +156,63 @@ def _zigzag(d: np.ndarray) -> np.ndarray:
     return ((d << 1) ^ (d >> 63)).astype(np.uint64)
 
 
+_VARINT_MAX_BYTES = 10  # ceil(64 / 7)
+
+
+def _leb128_encode(vals: np.ndarray) -> bytes:
+    """Vectorized LEB128 over a u64 array: byte-plane construction in
+    numpy, no per-value Python loop — these run on server pool workers
+    under the GIL, so a scalar loop over a padded plane of tens of
+    thousands of ids would serialize the whole pool."""
+    shifts = np.uint64(7) * np.arange(_VARINT_MAX_BYTES, dtype=np.uint64)
+    groups = (vals[:, None] >> shifts[None, :]) & np.uint64(0x7F)
+    nb = np.ones(vals.size, np.int64)
+    for k in range(1, _VARINT_MAX_BYTES):
+        nb += vals >= (np.uint64(1) << np.uint64(7 * k))
+    cols = np.arange(_VARINT_MAX_BYTES, dtype=np.int64)[None, :]
+    emit = cols < nb[:, None]
+    cont = cols < (nb[:, None] - 1)
+    mat = (groups | (cont.astype(np.uint64) << np.uint64(7))).astype(
+        np.uint8
+    )
+    # row-major selection = per value, little-endian 7-bit groups
+    return mat[emit].tobytes()
+
+
+def _leb128_decode(payload: np.ndarray, count: int) -> np.ndarray:
+    """Vectorized inverse of _leb128_encode for exactly `count` values;
+    truncation, >64-bit values, and trailing bytes raise ValueError."""
+    term = np.flatnonzero((payload & np.uint8(0x80)) == 0)
+    if term.size < count:
+        raise ValueError(
+            f"varint block truncated at value {term.size}/{count}"
+        )
+    ends = term[:count]
+    last = int(ends[-1])
+    if last != payload.size - 1:
+        raise ValueError(
+            f"varint block has {payload.size - 1 - last} trailing bytes"
+            f" after {count} values"
+        )
+    starts = np.empty(count, np.int64)
+    starts[0] = 0
+    starts[1:] = ends[:-1] + 1
+    lens = ends - starts + 1
+    if int(lens.max()) > _VARINT_MAX_BYTES:
+        raise ValueError("varint value overruns 64 bits")
+    wide = lens == _VARINT_MAX_BYTES
+    # a 10-byte varint's terminal group may only carry bit 63
+    if wide.any() and int(payload[ends[wide]].max()) > 1:
+        raise ValueError("varint value overruns 64 bits")
+    pos_in = np.arange(payload.size, dtype=np.int64) - starts[
+        np.repeat(np.arange(count), lens)
+    ]
+    contrib = (payload.astype(np.uint64) & np.uint64(0x7F)) << (
+        np.uint64(7) * pos_in.astype(np.uint64)
+    )
+    return np.bitwise_or.reduceat(contrib, starts)
+
+
 def encode_u64_delta(arr) -> bytes:
     """u64 array -> framed zigzag-delta LEB128 varint bytes. Exact for
     ANY value order (zigzag absorbs negative deltas); sorted runs are
@@ -178,13 +235,7 @@ def encode_u64_delta(arr) -> bytes:
     vals[1:] = _zigzag(
         (flat[1:].astype(np.int64) - flat[:-1].astype(np.int64))
     )
-    out = bytearray()
-    for v in vals.tolist():
-        while v >= 0x80:
-            out.append((v & 0x7F) | 0x80)
-            v >>= 7
-        out.append(v)
-    return head + bytes(out)
+    return head + _leb128_encode(vals)
 
 
 def decode_u64_delta(blob) -> np.ndarray:
@@ -197,39 +248,23 @@ def decode_u64_delta(blob) -> np.ndarray:
     ver, count, crc = head.unpack_from(blob, 0)
     if ver != _FRAME_VERSION:
         raise ValueError(f"varint block: unknown version {ver}")
-    pos, end = head.size, len(blob)
+    payload = np.frombuffer(blob, np.uint8, offset=head.size)
     # every value takes >= 1 byte: a corrupt count cannot be allowed to
     # size the allocation (a flipped header byte would ask for TiB)
-    if count > end - pos:
+    if count > payload.size:
         raise ValueError(
             f"varint block declares {count} values but carries only"
-            f" {end - pos} payload bytes"
+            f" {payload.size} payload bytes"
         )
-    vals = np.empty(count, np.uint64)
-    for i in range(count):
-        shift = 0
-        acc = 0
-        while True:
-            if pos >= end:
-                raise ValueError(
-                    f"varint block truncated at value {i}/{count}"
-                )
-            b = blob[pos]
-            pos += 1
-            acc |= (b & 0x7F) << shift
-            if not b & 0x80:
-                break
-            shift += 7
-            if shift >= 70:
-                raise ValueError("varint value overruns 64 bits")
-        if acc >> 64:
-            raise ValueError("varint value overruns 64 bits")
-        vals[i] = np.uint64(acc)
-    if pos != end:
-        raise ValueError(
-            f"varint block has {end - pos} trailing bytes after"
-            f" {count} values"
-        )
+    if count == 0:
+        if payload.size:
+            raise ValueError(
+                f"varint block has {payload.size} trailing bytes after"
+                " 0 values"
+            )
+        vals = np.empty(0, np.uint64)
+    else:
+        vals = _leb128_decode(payload, count)
     if count:
         # un-zigzag the delta tail, then prefix-sum on the u64 ring
         d = vals[1:]
@@ -248,6 +283,14 @@ def decode_u64_delta(blob) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _row_range(vals: np.ndarray):
+    # per-row (min, max); zero-width rows quantize exactly to their lo
+    if vals.shape[1] == 0:
+        zero = np.zeros(len(vals), np.float32)
+        return zero, zero
+    return vals.min(axis=1), vals.max(axis=1)
+
+
 def quantize(kind: str, vals: np.ndarray):
     """f32 [n, F] -> list of wire arrays for `kind`:
     "f32" -> [vals] (exact); "bf16" -> [bf16 vals]; "int8" ->
@@ -262,8 +305,10 @@ def quantize(kind: str, vals: np.ndarray):
     if kind == "int8":
         if vals.ndim != 2:
             vals = vals.reshape(len(vals), -1)
-        lo = vals.min(axis=1, initial=0.0)
-        hi = vals.max(axis=1, initial=0.0)
+        # true per-row min/max: widening the range to include 0 (an
+        # `initial=` clamp) would blow the documented (rowmax-rowmin)/254
+        # PARITY budget for rows living far from the origin
+        lo, hi = _row_range(vals)
         scale = np.maximum((hi - lo) / 255.0, np.float32(1e-30)).astype(
             np.float32
         )
@@ -313,7 +358,6 @@ def quant_error_budget(kind: str, vals: np.ndarray) -> np.ndarray:
         # 2^-8 leaves headroom for subnormal edges
         return np.abs(vals).max(axis=1, initial=0.0) * np.float32(2**-8)
     if kind == "int8":
-        lo = vals.min(axis=1, initial=0.0)
-        hi = vals.max(axis=1, initial=0.0)
+        lo, hi = _row_range(vals)
         return ((hi - lo) / 254.0).astype(np.float32)
     raise ValueError(f"unknown page dtype {kind!r}")

@@ -543,7 +543,11 @@ class ReplicaCoordinator:
         raw = bytes(np.ascontiguousarray(data)) if len(data) else b""
         if len(reply) >= 6:
             link.new_proto = True
-            return codec.decompress(str(reply[4]), raw)
+            # an expired long poll answers EMPTY and unframed (the
+            # server only compresses non-empty batches): nothing to
+            # decode, and handing b"" to decompress would turn every
+            # idle poll cycle into a dropped link
+            return codec.decompress(str(reply[4]), raw) if raw else b""
         link.new_proto = False
         return raw
 

@@ -135,13 +135,16 @@ def test_varint_flip_every_byte_is_typed():
 def test_quant_budgets_per_dtype():
     rng = np.random.default_rng(7)
     # mixed magnitudes: normals, a huge-magnitude row, a constant row,
-    # and a zero row — the budget must hold elementwise on all of them
+    # a zero row, and a tight row living FAR from the origin (the int8
+    # range must be the true row min/max — clamping it to include 0
+    # would blow the documented (rowmax-rowmin)/254 budget here)
     vals = np.concatenate(
         [
             rng.normal(size=(30, 16)).astype(np.float32),
             (rng.normal(size=(2, 16)) * 1e6).astype(np.float32),
             np.full((1, 16), 3.25, np.float32),
             np.zeros((1, 16), np.float32),
+            (rng.normal(size=(2, 16)) + 1000.0).astype(np.float32),
         ]
     )
     # f32 is the exact default: bitwise, not approximately
@@ -290,6 +293,82 @@ def test_full_nb_codec_toggle_bit_parity(solo, monkeypatch):
     for a, b in zip(raw_out, delta_out):
         assert np.array_equal(np.asarray(a), np.asarray(b))
     assert delta_bytes < raw_bytes
+
+
+def test_dense_mixed_fleet_never_mixes_cache_block_shapes(
+    solo, monkeypatch
+):
+    """Rolling-upgrade fleet: one replica answers the quantized 3-part
+    int8 block, another the old 1-part f32 block, through ONE handle's
+    read cache. The 1-part reply must never enter the quantized cache
+    key (mixed tuple shapes would break assembly) — the batch redoes on
+    the exact f32 keyspace and the handle degrades sticky."""
+    g, svc = solo
+    rs = _fresh_handle(svc, monkeypatch, page_dtype="int8")
+    old_replica = [False]
+    orig = rs.call
+
+    def mixed_fleet_call(op, values, **kw):
+        if op == "get_dense_feature" and old_replica[0]:
+            values = values[:2]  # this replica predates the offer arg
+        return orig(op, values, **kw)
+
+    monkeypatch.setattr(rs, "call", mixed_fleet_call)
+    ids_a = np.arange(1, 9, dtype=np.uint64)
+    ids_b = np.arange(9, 17, dtype=np.uint64)
+    exact_a = g.shards[0].get_dense_feature(ids_a, ["feat"])
+    exact_b = g.shards[0].get_dense_feature(ids_b, ["feat"])
+    budget = codec.quant_error_budget("int8", exact_a)
+    got_a = rs.get_dense_feature(ids_a, ["feat"])  # new replica: 3-part
+    assert (np.abs(got_a - exact_a) <= budget[:, None] + 1e-30).all()
+    old_replica[0] = True  # failover lands on a pre-codec replica
+    got_b = rs.get_dense_feature(ids_b, ["feat"])
+    assert got_b.tobytes() == exact_b.tobytes()  # verbatim f32, no crash
+    assert rs._dense_wire is False  # sticky degrade
+    # the whole fleet now reads exact f32 — including the ids the
+    # quantized key cached earlier
+    both = rs.get_dense_feature(
+        np.concatenate([ids_a, ids_b]), ["feat"]
+    )
+    assert both.tobytes() == np.concatenate(
+        [exact_a, exact_b]
+    ).tobytes()
+
+
+# -- empty long-poll replies on the codec-aware tail ----------------------
+
+
+def test_ship_payload_empty_longpoll_reply_is_not_a_fault():
+    """A codec-aware primary answers an expired wal_ship long poll with
+    an EMPTY unframed payload; the follower must read that as 'no new
+    records' — decoding it would throw every idle poll cycle and make
+    _tail_loop drop and re-dial the link several times per second."""
+    from euler_tpu.distributed.replication import (
+        ReplicaCoordinator,
+        _PrimaryLink,
+    )
+
+    link = _PrimaryLink("127.0.0.1", 1)
+    empty_new = [0, np.empty(0, np.uint8), 0, False, "zlib", 0, 0]
+    assert ReplicaCoordinator._ship_payload(link, empty_new) == b""
+    assert link.new_proto is True  # still proven codec-aware
+    # non-empty new-shape replies keep decoding (and keep raising on
+    # damage — the corruption stance is unchanged)
+    raw = b"record-bytes" * 40
+    blob = np.frombuffer(codec.compress("zlib", raw), np.uint8)
+    full_new = [0, blob, len(raw), False, "zlib", len(raw), len(raw)]
+    assert ReplicaCoordinator._ship_payload(link, full_new) == raw
+    bad = blob.copy()
+    bad[-1] ^= 0xFF
+    with pytest.raises(ValueError):
+        ReplicaCoordinator._ship_payload(
+            link, [0, bad, len(raw), False, "zlib", len(raw), len(raw)]
+        )
+    # old-shape empty replies stay the old no-op
+    assert ReplicaCoordinator._ship_payload(
+        link, [0, np.empty(0, np.uint8), 0, False]
+    ) == b""
+    assert link.new_proto is False
 
 
 # -- wire byte counters, both sides --------------------------------------
