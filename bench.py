@@ -1136,6 +1136,92 @@ def _bytes_lane(smoke: bool) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _retrieval_lane(smoke: bool) -> dict:
+    """Retrieval-serving lane (ISSUE 17; EULER_BENCH_RETRIEVAL=0
+    opt-out): filtered/unfiltered top-K queries/s and latency tails over
+    a 2-shard fleet, the router's fan-out-vs-merge split, and the
+    standing `retrieval_bit_parity` oracle — every measured answer is
+    also checked bit-for-bit against the single-process NumPy reference,
+    so a throughput number from a wrong answer can never land on the
+    artifact."""
+    from euler_tpu.retrieval import EmbeddingCorpus, numpy_topk_oracle
+    from euler_tpu.retrieval.client import RetrievalClient
+    from euler_tpu.retrieval.server import RetrievalServer
+
+    n, dim, queries, k = (300, 16, 40, 8) if smoke else (20_000, 64, 300, 32)
+    rng = np.random.default_rng(17)
+    ids = np.sort(
+        rng.choice(max(10 * n, 1000), size=n, replace=False).astype(np.uint64)
+    )
+    vecs = rng.standard_normal((n, dim)).astype(np.float32)
+    attrs = {"cat": rng.integers(0, 4, size=n)}
+    corpus = EmbeddingCorpus.build(ids, vecs, attrs=attrs, metric="cosine")
+    dnf = [[("cat", "in", [0, 2])]]
+    mask = np.isin(np.asarray(attrs["cat"]), [0, 2])
+    servers, shard_addrs = [], []
+    cli = None
+    try:
+        for part in range(2):
+            srv = RetrievalServer(
+                corpus=corpus, part=part, num_parts=2, warm_k=k
+            ).start()
+            servers.append(srv)
+            shard_addrs.append([(srv.host, srv.port)])
+        cli = RetrievalClient(shard_addrs)
+        qs = rng.standard_normal((queries, 4, dim)).astype(np.float32)
+        parity = True
+
+        def measure(use_dnf):
+            nonlocal parity
+            lat = []
+            cli.retrieve(qs[0], k, dnf=dnf if use_dnf else None)  # warm
+            for q in qs:
+                t1 = time.perf_counter()
+                got = cli.retrieve(q, k, dnf=dnf if use_dnf else None)
+                lat.append((time.perf_counter() - t1) * 1e3)
+                # oracle check OUTSIDE the timed span: throughput must
+                # not price the referee in
+                want = numpy_topk_oracle(
+                    ids, vecs, q, k, metric="cosine",
+                    mask=mask if use_dnf else None,
+                )
+                parity = parity and all(
+                    np.array_equal(np.asarray(g), np.asarray(w))
+                    for g, w in zip(got, want)
+                )
+            total = sum(lat) / 1e3
+            lat = np.sort(np.asarray(lat))
+            return (
+                queries / total,
+                float(lat[len(lat) // 2]),
+                float(lat[min(len(lat) - 1, int(len(lat) * 0.99))]),
+            )
+
+        qps, p50, p99 = measure(False)
+        fqps, _, _ = measure(True)
+        rst = cli.router.stats()
+        busy = rst["fanout_s"] + rst["merge_s"]
+        return {
+            "retrieval": True,
+            "retrieval_rows": n,
+            "retrieval_queries_per_sec": round(qps, 1),
+            "retrieval_p50_ms": round(p50, 3),
+            "retrieval_p99_ms": round(p99, 3),
+            "retrieval_filtered_over_unfiltered": round(
+                fqps / max(qps, 1e-9), 3
+            ),
+            "retrieval_merge_overhead_pct": round(
+                100.0 * rst["merge_s"] / max(busy, 1e-9), 2
+            ),
+            "retrieval_bit_parity": bool(parity),
+        }
+    finally:
+        if cli is not None:
+            cli.close()
+        for srv in servers:
+            srv.stop()
+
+
 def _resume_lane(smoke: bool) -> dict:
     """Durable-training lane (ISSUE 10; EULER_BENCH_RESUME=0 opt-out):
     checkpoint cost on the step path with the async writer vs inline
@@ -1707,6 +1793,18 @@ def run(platform: str) -> tuple[float, dict]:
 
             traceback.print_exc()
             extra.update({"bytes": False, "bytes_error": repr(e)[:300]})
+    # retrieval-serving lane (ISSUE 17) — fleet top-K queries/s, latency
+    # tails, merge overhead, and the bitwise parity oracle
+    if os.environ.get("EULER_BENCH_RETRIEVAL", "1") != "0":
+        try:
+            extra.update(_retrieval_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update(
+                {"retrieval": False, "retrieval_error": repr(e)[:300]}
+            )
     probe = _probe_meta()
     if probe:
         extra["probe"] = probe

@@ -95,6 +95,16 @@ DEFAULT_DOMAINS = (
         ),
         servers=("euler_tpu/serving/server.py",),
     ),
+    WireDomain(
+        name="retrieval",
+        # embedding top-K fleet (ISSUE 17): retrieve rides the router's
+        # fan-out, the fleet ops ride the client's per-replica handles
+        clients=(
+            "euler_tpu/retrieval/client.py",
+            "euler_tpu/retrieval/router.py",
+        ),
+        servers=("euler_tpu/retrieval/server.py",),
+    ),
 )
 
 

@@ -259,7 +259,48 @@ def _union_many(parts: list[np.ndarray]) -> np.ndarray:
     return np.unique(np.concatenate(parts))
 
 
-class IndexManager:
+class DnfEvaluator:
+    """DNF walk over per-field indexes — the shape shared by the graph
+    shard's `IndexManager` and the retrieval corpus's attribute index
+    (retrieval/corpus.py). Subclasses provide `_index_for(field)` plus
+    `_weights`/`_num_rows`; the condition algebra (AND = intersect
+    within a clause, OR = union across clauses, empty DNF = everything)
+    lives here exactly once so both surfaces stay semantically
+    identical."""
+
+    _weights: np.ndarray
+    _num_rows: int
+
+    def _index_for(self, field: str):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def search(self, field: str, op: str, value=None) -> IndexResult:
+        if op not in OPS:
+            raise ValueError(f"unknown condition op {op!r}")
+        return IndexResult(
+            self._index_for(field).search(op, value), self._weights
+        )
+
+    def search_dnf(self, dnf) -> IndexResult:
+        """dnf = [[(field, op, value), ...AND...], ...OR...]."""
+        out: IndexResult | None = None
+        for clause in dnf:
+            cur: IndexResult | None = None
+            for atom in clause:
+                field, op, value = (tuple(atom) + (None,))[:3]
+                res = self.search(field, op, value)
+                cur = res if cur is None else cur.intersect(res)
+            if cur is None:
+                continue
+            out = cur if out is None else out.union(cur)
+        if out is None:
+            out = IndexResult(
+                np.arange(self._num_rows, dtype=np.int64), self._weights
+            )
+        return out
+
+
+class IndexManager(DnfEvaluator):
     """Per-shard index registry + DNF evaluator.
 
     Parity: IndexManager::Instance() (index_manager.h:35-58) except indexes
@@ -336,29 +377,66 @@ class IndexManager:
         self._cache[field] = idx
         return idx
 
-    # ---- DNF evaluation -------------------------------------------------
+    # ---- selective carry across delta merges ----------------------------
 
-    def search(self, field: str, op: str, value=None) -> IndexResult:
-        if op not in OPS:
-            raise ValueError(f"unknown condition op {op!r}")
-        return IndexResult(
-            self._index_for(field).search(op, value), self._weights
-        )
+    def _backing_keys(self, field: str) -> list[str] | None:
+        """The array-dict keys whose bytes a field's index is built from
+        (None = unknown field: never carried). `merge_delta` carries
+        untouched arrays BY REFERENCE, so key-by-key identity between the
+        old and new array dicts proves the index's inputs are unchanged."""
+        st = self._store
+        if field == "id":
+            return ["node_ids"] if self._node else []
+        if field in ("type", "label", "__label__"):
+            return ["node_types"] if self._node else ["edge_types"]
+        if field == "weight":
+            return ["node_weights"] if self._node else ["edge_weights"]
+        try:
+            spec = st.meta.feature_spec(field, node=self._node)
+        except (KeyError, ValueError):
+            return None
+        prefix = "nf" if self._node else "ef"
+        if spec.kind == DENSE:
+            return [f"{prefix}_dense_{spec.fid}"]
+        if spec.kind == SPARSE:
+            return [
+                f"{prefix}_sparse_{spec.fid}_indptr",
+                f"{prefix}_sparse_{spec.fid}_values",
+            ]
+        if spec.kind == BINARY:
+            return [
+                f"{prefix}_bin_{spec.fid}_indptr",
+                f"{prefix}_bin_{spec.fid}_values",
+            ]
+        return None
 
-    def search_dnf(self, dnf) -> IndexResult:
-        """dnf = [[(field, op, value), ...AND...], ...OR...]."""
-        out: IndexResult | None = None
-        for clause in dnf:
-            cur: IndexResult | None = None
-            for atom in clause:
-                field, op, value = (tuple(atom) + (None,))[:3]
-                res = self.search(field, op, value)
-                cur = res if cur is None else cur.intersect(res)
-            if cur is None:
+    def carry_from(self, old: "IndexManager", old_arrays: dict,
+                   new_arrays: dict) -> int:
+        """Adopt the per-field index objects an epoch publish did NOT
+        touch (merge_delta cost control — see GraphStore.merge_delta).
+
+        A cached index of `old` is carried iff the row numbering is
+        provably unchanged (same row count AND the id column rode through
+        the merge by reference — any insert/delete rewrites it) and every
+        backing array of the field is the SAME object in both array
+        dicts. Indexes map values to row numbers, so both conditions
+        together make the carried object bit-identical to a rebuild;
+        everything else stays lazy and rebuilds on first use. Returns the
+        number of carried fields (telemetry + test pin)."""
+        if old._node != self._node or old._num_rows != self._num_rows:
+            return 0
+        anchor = "node_ids" if self._node else "edge_src"
+        if new_arrays.get(anchor) is not old_arrays.get(anchor):
+            return 0
+        carried = 0
+        for field, idx in old._cache.items():
+            keys = self._backing_keys(field)
+            if keys is None:
                 continue
-            out = cur if out is None else out.union(cur)
-        if out is None:
-            out = IndexResult(
-                np.arange(self._num_rows, dtype=np.int64), self._weights
-            )
-        return out
+            if all(
+                k in old_arrays and new_arrays.get(k) is old_arrays[k]
+                for k in keys
+            ):
+                self._cache[field] = idx
+                carried += 1
+        return carried

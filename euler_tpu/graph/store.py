@@ -375,15 +375,18 @@ class GraphStore:
         its store reference in one assignment, so in-flight reads finish
         on this (immutable) snapshot and hot-path readers can never see
         a torn mix of epochs — the same swap discipline as the serving
-        hot reload. Samplers, edge-key indexes, and attribute indexes
-        rebuild lazily on the new store (the "sampler alias" rebuild is
-        confined to the merged shard).
+        hot reload. Samplers and edge-key indexes rebuild lazily on the
+        new store (the "sampler alias" rebuild is confined to the merged
+        shard); attribute indexes whose backing columns rode through the
+        merge by reference are CARRIED (IndexManager.carry_from), so a
+        publish only pays index rebuilds for the fields it touched.
 
         Bit-parity contract: the merged arrays equal a from-scratch
         ``build_from_json`` of the equivalently mutated graph.json —
         pinned by tests/test_delta.py.
         """
         from euler_tpu.graph.delta import merge_arrays
+        from euler_tpu.graph.index import IndexManager
 
         with self._lock:
             new_arrays, rows, ids = merge_arrays(
@@ -391,6 +394,19 @@ class GraphStore:
             )
             new_store = GraphStore(self.meta, new_arrays, self.part)
             new_store.graph_epoch = self.graph_epoch + 1
+            # attribute-index carry: merge_arrays moves untouched columns
+            # by reference, so any per-field index whose backing arrays
+            # (and the row numbering) rode through unchanged is adopted
+            # into the new store instead of rebuilt on first conditioned
+            # query — parity vs a full rebuild pinned in tests/test_index.py
+            for attr, node in (("_index_mgr", True),
+                               ("_edge_index_mgr", False)):
+                old_mgr = getattr(self, attr)
+                if old_mgr is None or not old_mgr._cache:
+                    continue
+                mgr = IndexManager(new_store, node=node)
+                mgr.carry_from(old_mgr, self.arrays, new_arrays)
+                setattr(new_store, attr, mgr)
         return new_store, rows, ids
 
     # ---- id resolution -------------------------------------------------

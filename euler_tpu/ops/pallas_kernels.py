@@ -493,3 +493,108 @@ def paged_page_search(bound, pstart, npages, rbits, iters: int):
         lo = jnp.where(active & le, mid + 1, lo)
         hi = jnp.where(active & ~le, mid, hi)
     return lo - pstart[:, None].astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Retrieval scoring kernel (embedding top-K serving lane)
+# ---------------------------------------------------------------------------
+
+# lane rows streamed per grid step in the score kernel. Unlike the
+# gather kernels above, the corpus scan is data-INdependent (every page
+# is read exactly once, in order), so BlockSpec grid streaming stages
+# HBM -> VMEM and Mosaic's automatic pipelining double-buffers it — no
+# manual DMA/semaphore choreography needed.
+SCORE_TILE = 8
+
+
+def _topk_score_kernel(dp, rows_per, x_ref, q_ref, out_ref):
+    # one lane-row tile holds SCORE_TILE * rows_per packed dp-vectors
+    # (row-major flat layout, dp | PAGE_LANES so no vector straddles a
+    # lane row). The d-loop is a STATIC unroll: the same left-to-right
+    # f32 (mul, add) chain as the jitted reference, so scores are
+    # bit-identical across impls by construction.
+    rows = x_ref.shape[0] * rows_per
+    x = x_ref[:].reshape(rows, dp)
+    acc = jnp.zeros((q_ref.shape[0], rows), jnp.float32)
+    for d in range(dp):
+        acc = acc + q_ref[:, d][:, None] * x[:, d][None, :]
+    out_ref[:] = acc
+
+
+def _paged_topk_score_pallas(table2d, q, dp, interpret: bool):
+    rows_per = PAGE_LANES // dp
+    b = q.shape[0]
+    pad = (-table2d.shape[0]) % SCORE_TILE
+    if pad:
+        table2d = jnp.pad(table2d, ((0, pad), (0, 0)))
+    mt = table2d.shape[0]
+    # query lane-padded to the register width; the kernel only reads the
+    # first dp lanes, and padding with zeros keeps the pad inert
+    qp = jnp.pad(q, ((0, 0), (0, PAGE_LANES - dp)))
+    return pl.pallas_call(
+        functools.partial(_topk_score_kernel, dp, rows_per),
+        grid=(mt // SCORE_TILE,),
+        in_specs=[
+            pl.BlockSpec(
+                (SCORE_TILE, PAGE_LANES),
+                lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (b, PAGE_LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (b, SCORE_TILE * rows_per),
+            lambda i: (0, i),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, mt * rows_per), jnp.float32),
+        interpret=interpret,
+    )(table2d, qp)
+
+
+def paged_topk_score(table2d, q, nrows: int, dp: int, impl: str = "auto"):
+    """scores[b, i] = <flat(table2d)[i*dp : (i+1)*dp], q[b, :dp]> — the
+    brute-force retrieval scorer over a paged corpus.
+
+    `table2d` is the [M, 128] lane-row view (`_as_lane_rows`) of a flat
+    f32 buffer holding `nrows` packed dp-wide vectors; `q` is [B, dp]
+    f32 queries. Returns [B, nrows] f32 scores.
+
+    Bit-reproducibility contract (the retrieval parity oracle leans on
+    it): the dot product accumulates STRICTLY left-to-right in f32 —
+    acc = f32(acc + x[d] * q[d]) for d = 0..dp-1 — in every impl and in
+    the NumPy oracle (retrieval/topk.py), so scores are bit-identical
+    across 'xla'/'pallas'/'interpret'/NumPy rather than at the mercy of
+    a reduction order XLA is free to pick. The contract additionally
+    REQUIRES operands with 12-bit-truncated significands
+    (retrieval/corpus.py quantize_sig12): LLVM contracts the mul+add
+    into FMA non-uniformly on CPU (no HLO barrier or XLA flag stops
+    it), and only exact products — which 12x12-bit significands
+    guarantee — make fma(x, q, acc) == f32(x*q) + acc identically.
+    Same impl discipline as paged_gather: 'auto' routes to the jitted
+    reference until a measured on-chip win; the Pallas form ('pallas',
+    dp | 128 only) is interpret-validated in tests/test_pallas.py.
+    """
+    impl = _paged_impl(impl)
+    q = q.astype(jnp.float32)
+    if impl == "xla":
+        flat = table2d.reshape(-1)[: nrows * dp]
+        x = flat.astype(jnp.float32).reshape(nrows, dp)
+
+        def body(d, acc):
+            xcol = jax.lax.dynamic_index_in_dim(x, d, 1, keepdims=False)
+            qcol = jax.lax.dynamic_index_in_dim(q, d, 1, keepdims=False)
+            return acc + qcol[:, None] * xcol[None, :]
+
+        acc = jnp.zeros((q.shape[0], nrows), jnp.float32)
+        return jax.lax.fori_loop(0, dp, body, acc)
+    if dp < 1 or PAGE_LANES % dp:
+        raise ValueError(
+            f"paged_topk_score pallas impl needs dp | {PAGE_LANES}, got {dp}"
+        )
+    out = _paged_topk_score_pallas(
+        table2d.astype(jnp.float32), q, dp, interpret=(impl == "interpret")
+    )
+    return out[:, :nrows]

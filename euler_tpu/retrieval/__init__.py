@@ -1,0 +1,40 @@
+"""Embedding retrieval serving: sharded on-device top-K over paged HBM
+tables, DNF-filtered candidates, hot-swapped corpus versions.
+
+  corpus.py  immutable versioned EmbeddingCorpus (checkpoint → paged
+             table + id map + attribute columns)
+  topk.py    jitted bucket-padded brute-force top-K, independent NumPy
+             oracle, canonical-order shard merge
+  server.py  RetrievalServer — retrieve/corpus_stats/reload_corpus wire
+             verbs over _PoolServer, dual-engine version pinning
+  router.py  RetrievalRouter — concurrent fan-out, hedging, heap merge,
+             mixed-version convergence
+  client.py  RetrievalClient — fleet facade (query + stats + rolling
+             hot swap)
+"""
+
+from euler_tpu.retrieval.corpus import (  # noqa: F401
+    INVALID_ID,
+    EmbeddingCorpus,
+    normalize_rows,
+    pad_dim,
+    quantize_sig12,
+)
+from euler_tpu.retrieval.topk import (  # noqa: F401
+    TopKIndex,
+    bucket_for,
+    merge_topk,
+    numpy_topk_oracle,
+)
+
+__all__ = [
+    "INVALID_ID",
+    "EmbeddingCorpus",
+    "normalize_rows",
+    "pad_dim",
+    "quantize_sig12",
+    "TopKIndex",
+    "bucket_for",
+    "merge_topk",
+    "numpy_topk_oracle",
+]

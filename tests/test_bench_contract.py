@@ -188,6 +188,17 @@ def test_bench_smoke_emits_final_json_line():
     # ...and the follower must actually overlap apply with the next
     # fetch (speculative requests answered, not lockstep)
     assert row["bytes_ship_pipelined_batches"] >= 1, row
+    # the retrieval-serving lane (ISSUE 17) must not silently vanish:
+    # fleet top-K throughput, latency tails, the router's merge share,
+    # the filtered/unfiltered ratio, and — the key that gates every
+    # other number — the standing bitwise oracle
+    assert row["retrieval"] is True, row
+    assert row["retrieval_queries_per_sec"] > 0
+    assert row["retrieval_p50_ms"] > 0
+    assert row["retrieval_p99_ms"] >= row["retrieval_p50_ms"]
+    assert row["retrieval_filtered_over_unfiltered"] > 0
+    assert 0 <= row["retrieval_merge_overhead_pct"] <= 100
+    assert row["retrieval_bit_parity"] is True, row
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline

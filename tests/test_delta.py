@@ -711,3 +711,37 @@ def test_write_cli_selftest(capsys):
 
     assert main(["--selftest"]) == 0
     assert "selftest ok" in capsys.readouterr().out
+
+
+def test_publish_then_conditioned_sample_is_fresh(cluster2):
+    """Epoch-staleness audit of the condition surface (ISSUE 17
+    satellite): conditioned verbs are never ReadCache-held (fresh RPC
+    per call) and the facade re-runs search_condition on every sample,
+    so the very next conditioned query after GraphWriter.publish must
+    see the merged state. The one snapshot in the surface is a held
+    _RemoteCondition's total_weight — pinned below as a snapshot whose
+    dnf still re-evaluates fresh server-side."""
+    _, g, services = cluster2
+    dnf = [[("weight", "ge", 100.0)]]
+    sh = g.shards[0]
+    pre_handle = sh.search_condition(dnf)
+    assert pre_handle.total_weight == 0.0
+    assert len(sh.get_node_ids_by_condition(dnf)) == 0
+
+    w = GraphWriter(g)
+    w.upsert_nodes([776], [0], [123.0])  # 776 % 2 == 0 -> shard 0
+    w.publish()
+
+    # fresh handle: weight and membership reflect the publish immediately
+    post_handle = sh.search_condition(dnf)
+    assert post_handle.total_weight == 123.0
+    assert sh.get_node_ids_by_condition(dnf).tolist() == [776]
+    # facade-level conditioned sampling sees it too (re-search per call)
+    rng = np.random.default_rng(0)
+    got = g.sample_node_with_condition(8, dnf, rng=rng)
+    assert got.tolist() == [776] * 8
+    # the PRE-publish handle: its dnf re-evaluates fresh on the server
+    # (rows are never stale) — only its total_weight is a snapshot
+    sampled = sh.sample_from_result(pre_handle, 4)
+    assert np.asarray(sampled).tolist() == [776] * 4
+    assert pre_handle.total_weight == 0.0

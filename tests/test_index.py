@@ -181,3 +181,83 @@ def test_negative_value_on_unsigned_column():
     g = _graph()
     assert len(g.get_node_ids_by_condition([[("id", "lt", -1)]])) == 0
     assert len(g.get_node_ids_by_condition([[("id", "ge", -1)]])) == 40
+
+
+# ---------------------------------------------------------------------------
+# index carry across merge_delta (ISSUE 17 satellite): only indexes whose
+# backing columns were touched rebuild; untouched ones ride through by
+# reference — pinned bit-parity vs a full rebuild
+# ---------------------------------------------------------------------------
+
+
+def test_index_carry_after_merge_delta_parity_and_identity():
+    from euler_tpu.graph.delta import DeltaStore
+    from euler_tpu.graph.index import IndexManager
+
+    g = _graph(1)
+    store = g.shards[0]
+    mgr = store.index_manager
+    dnfs = (
+        [[("price", "lt", 10)]],
+        [[("city", "eq", "nyc")]],
+        [[("tags", "haskey", 1)]],
+    )
+    for dnf in dnfs:
+        mgr.search_dnf(dnf)
+    assert {"price", "city", "tags"} <= set(mgr._cache)
+    city_idx = mgr._cache["city"]
+    tags_idx = mgr._cache["tags"]
+
+    # feature-only upsert of an EXISTING node: touches the price column,
+    # leaves city/tags (and the id anchor) riding through by reference
+    d = DeltaStore(0, 1)
+    d.stage_nodes(
+        [2], [0], [2.0], ["price"], np.array([[999.0]], np.float32)
+    )
+    new_store, _, _ = store.merge_delta(d)
+
+    new_mgr = new_store.index_manager
+    assert new_mgr is not mgr
+    # untouched columns: the SAME index objects were carried
+    assert new_mgr._cache.get("city") is city_idx
+    assert new_mgr._cache.get("tags") is tags_idx
+    # touched column: dropped from the carry (lazily rebuilt on demand)
+    assert "price" not in new_mgr._cache
+
+    # parity: every conditioned search over the carried manager matches
+    # a from-scratch rebuild on the merged store exactly
+    fresh = IndexManager(new_store)
+    for dnf in dnfs:
+        got = new_mgr.search_dnf(dnf)
+        want = fresh.search_dnf(dnf)
+        assert np.array_equal(got.rows, want.rows), dnf
+        assert got.total_weight == want.total_weight, dnf
+    # and the mutated row actually moved out of the lt-10 bucket
+    row2 = int(new_store.lookup([2])[0])
+    assert row2 not in set(
+        new_mgr.search_dnf([[("price", "lt", 10)]]).rows.tolist()
+    )
+    assert row2 in set(
+        new_mgr.search_dnf([[("price", "ge", 999)]]).rows.tolist()
+    )
+
+
+def test_index_carry_declines_on_structural_change():
+    """New-node merges rewrite row numbering: nothing may be carried."""
+    from euler_tpu.graph.delta import DeltaStore
+
+    g = _graph(1)
+    store = g.shards[0]
+    mgr = store.index_manager
+    mgr.search_dnf([[("city", "eq", "nyc")]])
+    city_idx = mgr._cache["city"]
+
+    d = DeltaStore(0, 1)
+    d.stage_nodes([4242], [0], [1.0])  # brand-new id: structural
+    new_store, _, _ = store.merge_delta(d)
+    new_mgr = new_store.index_manager
+    carried = new_mgr._cache.get("city")
+    assert carried is None or carried is not city_idx
+    # and a fresh search still answers correctly over the grown store
+    res = new_mgr.search_dnf([[("city", "eq", "nyc")]])
+    assert len(res.rows) == 20  # the 20 even-index nodes of the fixture

@@ -227,3 +227,36 @@ def test_gat_fused_grid_matches_scatter_path(rng):
     np.testing.assert_allclose(
         np.asarray(out_grid), np.asarray(out_flat), rtol=2e-5, atol=2e-6
     )
+
+
+def test_paged_topk_score_interpret_matches_xla_bitwise(rng):
+    """The paged retrieval scorer: 'interpret' == 'xla' == a strict
+    left-to-right NumPy accumulation, BITWISE.  Operands carry
+    12-bit-truncated significands (retrieval quantize_sig12 canon) so
+    every product is exact in f32 and LLVM's FMA contraction is a
+    semantic no-op — without that, parity is at the compiler's mercy."""
+    import jax.numpy as jnp
+
+    from euler_tpu.ops.pallas_kernels import PAGE_LANES, paged_topk_score
+    from euler_tpu.retrieval.corpus import quantize_sig12
+
+    nrows, dp, B = 257, 32, 5  # non-tile-multiple row count, dp | 128
+    x = quantize_sig12(
+        rng.standard_normal((nrows, dp)).astype(np.float32)
+    )
+    q = quantize_sig12(rng.standard_normal((B, dp)).astype(np.float32))
+    flat = x.reshape(-1)
+    flat = np.pad(flat, (0, (-flat.size) % PAGE_LANES))
+    t2d = jnp.asarray(flat.reshape(-1, PAGE_LANES))
+    ref = np.asarray(paged_topk_score(t2d, jnp.asarray(q), nrows, dp, "xla"))
+    out = np.asarray(
+        paged_topk_score(t2d, jnp.asarray(q), nrows, dp, "interpret")
+    )
+    assert ref.shape == (B, nrows)
+    assert np.array_equal(ref, out)  # bitwise, not allclose
+    acc = np.zeros((B, nrows), np.float32)  # left-to-right f32 oracle
+    for d in range(dp):
+        acc = acc + q[:, d][:, None] * x[:, d][None, :]
+    assert np.array_equal(ref, acc)
+    with pytest.raises(ValueError, match=r"dp \| 128"):
+        paged_topk_score(t2d, jnp.asarray(q), nrows, 24, "interpret")

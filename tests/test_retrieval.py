@@ -1,0 +1,600 @@
+"""Embedding retrieval serving (ISSUE 17): sharded on-device top-K over
+paged corpus tables, DNF-filtered candidates, hot-swapped versions.
+
+The canon every test here holds the line on: scores accumulate strictly
+left-to-right in f32 over operands with 12-bit-truncated significands
+(quantize_sig12 — every product exact, so LLVM's FMA contraction is a
+no-op), ties break (score desc, id asc), and the FLEET answer — any
+shard count, any replica count, mid-hot-swap, mid-replica-kill — is
+BIT-IDENTICAL to the single-process NumPy oracle. Parity asserts are
+`array_equal`, never `allclose`.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from euler_tpu.distributed import chaos
+from euler_tpu.distributed.chaos import Fault, FaultPlan
+from euler_tpu.distributed.errors import OverloadError, RpcError
+from euler_tpu.retrieval import (
+    EmbeddingCorpus,
+    TopKIndex,
+    merge_topk,
+    numpy_topk_oracle,
+    quantize_sig12,
+)
+from euler_tpu.retrieval.client import RetrievalClient
+from euler_tpu.retrieval.server import RetrievalServer
+from euler_tpu.serving.batcher import TenantQuota
+from euler_tpu.training.checkpoint import CheckpointStore
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+def _corpus(rng, n=120, d=10, metric="dot", seed_attrs=True):
+    ids = np.sort(rng.choice(10_000, size=n, replace=False).astype(np.uint64))
+    vecs = rng.standard_normal((n, d)).astype(np.float32)
+    attrs = (
+        {"cat": rng.integers(0, 3, size=n), "price": rng.uniform(1, 9, n)}
+        if seed_attrs
+        else None
+    )
+    return ids, vecs, EmbeddingCorpus.build(ids, vecs, attrs=attrs, metric=metric)
+
+
+# ---------------------------------------------------------------------------
+# single-process engine vs the oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("metric", ["dot", "cosine"])
+def test_topk_matches_oracle_bitwise(rng, metric):
+    ids, vecs, corpus = _corpus(rng, metric=metric)
+    idx = TopKIndex(corpus)
+    q = rng.standard_normal((6, 10)).astype(np.float32)
+    got = idx.search(q, 7)
+    want = numpy_topk_oracle(ids, vecs, q, 7, metric=metric)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_topk_filtered_and_edge_cases(rng):
+    ids, vecs, corpus = _corpus(rng)
+    idx = TopKIndex(corpus)
+    q = rng.standard_normal((3, 10)).astype(np.float32)
+    # DNF filter == the oracle under the equivalent boolean mask
+    dnf = [[("cat", "in", [0, 2])], [("price", "gt", 8.0)]]
+    mask = np.asarray(corpus.condition_mask(dnf))
+    assert mask.any() and not mask.all()
+    got = idx.search(q, 5, mask=mask)
+    want = numpy_topk_oracle(
+        corpus.ids, corpus.vectors[:, : corpus.dim], q, 5, mask=mask
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # k > matching rows: the tail is invalid, the head still exact
+    tiny = np.asarray(corpus.condition_mask([[("price", "lt", 1.3)]]))
+    n_match = int(tiny.sum())
+    assert 0 < n_match < 9
+    ids9, sc9, va9 = idx.search(q, 9, mask=tiny)
+    assert np.asarray(va9).sum() == n_match * len(q)
+    w_ids, w_sc, w_va = numpy_topk_oracle(
+        corpus.ids, corpus.vectors[:, : corpus.dim], q, 9, mask=tiny
+    )
+    np.testing.assert_array_equal(np.asarray(ids9), w_ids)
+    np.testing.assert_array_equal(np.asarray(va9), w_va)
+    # empty candidate set: all-invalid, never an exception
+    none_ids, _, none_va = idx.search(q, 4, mask=np.zeros(len(ids), bool))
+    assert not np.asarray(none_va).any()
+
+
+def test_tiebreak_is_id_ascending(rng):
+    """Duplicate vectors produce EQUAL scores; the canon breaks the tie
+    by id ascending, in the kernel and the oracle alike."""
+    d = 6
+    base = rng.standard_normal(d).astype(np.float32)
+    vecs = np.tile(base, (8, 1))  # 8 identical rows
+    ids = np.array([44, 2, 907, 13, 560, 71, 300, 5], np.uint64)
+    corpus = EmbeddingCorpus.build(ids, vecs)
+    q = rng.standard_normal((2, d)).astype(np.float32)
+    got_ids, got_sc, got_va = TopKIndex(corpus).search(q, 5)
+    got_ids = np.asarray(got_ids)
+    assert np.asarray(got_va).all()
+    for b in range(2):
+        np.testing.assert_array_equal(
+            got_ids[b], np.sort(ids)[:5]
+        )  # equal scores → smallest ids first, ascending
+    w_ids, _, _ = numpy_topk_oracle(ids, vecs, q, 5)
+    np.testing.assert_array_equal(got_ids, w_ids)
+
+
+def test_corpus_build_shard_lookup_semantics(rng):
+    ids, vecs, corpus = _corpus(rng, n=50, d=5)
+    # rows sorted by id; lookup maps external ids → rows (-1 = missing)
+    assert (np.diff(corpus.ids.astype(np.int64)) > 0).all()
+    pick = ids[[7, 3, 3, 20]]
+    rows = corpus.lookup(pick)
+    assert (rows >= 0).all()
+    by_id = {int(i): quantize_sig12(vecs[j]) for j, i in enumerate(ids)}
+    for r, i in zip(rows, pick):
+        np.testing.assert_array_equal(
+            corpus.vectors[r, : corpus.dim], by_id[int(i)]
+        )
+    missing = np.array([10_001], np.uint64)  # ids drawn below 10k
+    assert corpus.lookup(missing)[0] == -1
+    # shards partition the id set exactly, preserving the version
+    parts = [corpus.shard(p, 3) for p in range(3)]
+    assert sorted(np.concatenate([p.ids for p in parts]).tolist()) == sorted(
+        ids.tolist()
+    )
+    assert {p.version for p in parts} == {corpus.version}
+    with pytest.raises(ValueError):
+        EmbeddingCorpus.build(np.array([1, 1], np.uint64), vecs[:2])
+    # version string: lexicographic order == step order
+    c1 = EmbeddingCorpus.build(ids, vecs, step=3)
+    c2 = EmbeddingCorpus.build(ids, vecs, step=12)
+    assert c1.version < c2.version and c1.version.startswith("v000000000003-")
+
+
+def test_cosine_zero_rows_pass_through(rng):
+    ids = np.arange(4, dtype=np.uint64)
+    vecs = rng.standard_normal((4, 3)).astype(np.float32)
+    vecs[1] = 0.0
+    corpus = EmbeddingCorpus.build(ids, vecs, metric="cosine")
+    assert not np.asarray(corpus.vectors[1]).any()  # no NaN, no scaling
+
+
+def test_from_checkpoint_commit_discipline(rng, tmp_path):
+    """Only COMMITted checkpoints are visible; a torn dir (no COMMIT
+    marker — a crash mid-save) never feeds the corpus."""
+    import os
+
+    ids = np.arange(30, dtype=np.uint64)
+    t1 = rng.standard_normal((30, 4)).astype(np.float32)
+    store = CheckpointStore(str(tmp_path))
+    store.save_leaves(5, [t1], [], {})
+    # fake a torn step-9 dir: files present, COMMIT marker missing
+    torn = tmp_path / "ckpt_000000000009"
+    torn.mkdir()
+    (torn / "param_0000.npy").write_bytes(b"\x93NUMPY garbage")
+    c = EmbeddingCorpus.from_checkpoint(str(tmp_path), ids)
+    assert c.step == 5
+    np.testing.assert_array_equal(
+        c.vectors[:, : c.dim], quantize_sig12(t1)
+    )
+    assert os.path.isdir(torn)  # reader never "repairs" a torn dir
+    # ambiguous table → typed error telling the caller to pass leaf=
+    store.save_leaves(6, [t1, t1 + 1], [], {})
+    with pytest.raises(ValueError, match="pass leaf="):
+        EmbeddingCorpus.from_checkpoint(str(tmp_path), ids)
+    c6 = EmbeddingCorpus.from_checkpoint(str(tmp_path), ids, leaf=1)
+    np.testing.assert_array_equal(
+        c6.vectors[:, : c6.dim], quantize_sig12(t1 + 1)
+    )
+
+
+def test_merge_topk_equals_union_search(rng):
+    """Per-shard exact top-k merged by the router heap == one search
+    over the union corpus — the identity the whole fleet rests on."""
+    ids, vecs, corpus = _corpus(rng, n=90, d=8)
+    q = rng.standard_normal((5, 8)).astype(np.float32)
+    k = 6
+    parts = []
+    for p in range(3):
+        sh = corpus.shard(p, 3)
+        parts.append(
+            tuple(np.asarray(x) for x in TopKIndex(sh).search(q, k))
+        )
+    got = merge_topk(parts, k)
+    want = TopKIndex(corpus).search(q, k)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+# ---------------------------------------------------------------------------
+
+
+def _fleet(corpus_by_step, num_parts=2, replicas=2, **srv_kw):
+    """Boot a fleet over a mutable {'step': N} loader; returns
+    (servers, shard_addrs, bump) where bump(step) moves the loader."""
+    current = {"step": min(corpus_by_step)}
+
+    def loader(source):
+        step = (source or {}).get("step") or current["step"]
+        return corpus_by_step[step]
+
+    servers, shard_addrs = [], []
+    for part in range(num_parts):
+        reps = []
+        for _ in range(replicas):
+            srv = RetrievalServer(
+                loader=loader, part=part, num_parts=num_parts,
+                warm_k=8, **srv_kw
+            ).start()
+            servers.append(srv)
+            reps.append((srv.host, srv.port))
+        shard_addrs.append(reps)
+    return servers, shard_addrs, lambda step: current.__setitem__("step", step)
+
+
+@pytest.fixture
+def fleet(rng):
+    n, d = 140, 12
+    ids = np.sort(rng.choice(9_999, size=n, replace=False).astype(np.uint64))
+    tables = {
+        1: rng.standard_normal((n, d)).astype(np.float32),
+        2: rng.standard_normal((n, d)).astype(np.float32),
+    }
+    attrs = {"cat": rng.integers(0, 4, size=n)}
+    corpora = {
+        s: EmbeddingCorpus.build(ids, t, attrs=attrs, step=s)
+        for s, t in tables.items()
+    }
+    servers, shard_addrs, bump = _fleet(corpora)
+    cli = RetrievalClient(shard_addrs)
+    yield ids, tables, attrs, servers, shard_addrs, bump, cli
+    cli.close()
+    for s in servers:
+        s.stop()
+
+
+def test_fleet_bit_parity_and_stats(fleet, rng):
+    ids, tables, attrs, servers, _, _, cli = fleet
+    q = rng.standard_normal((4, 12)).astype(np.float32)
+    got = cli.retrieve(q, 9)
+    want = numpy_topk_oracle(ids, tables[1], q, 9)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    dnf = [[("cat", "in", [1, 3])]]
+    mask = np.isin(np.asarray(attrs["cat"]), [1, 3])
+    gotf = cli.retrieve(q, 9, dnf=dnf)
+    wantf = numpy_topk_oracle(ids, tables[1], q, 9, mask=mask)
+    for g, w in zip(gotf, wantf):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    st = cli.corpus_stats()
+    assert set(st) == {"0", "1"}  # JSON round-trip keys shards by str
+    assert sum(s["rows"] for s in st.values()) == len(ids)
+    assert {s["version"] for s in st.values()} == {
+        servers[0]._engine.corpus.version
+    }
+    pings = cli.ping_all()
+    assert len(pings) == 4 and all(p is True for p in pings.values())
+
+
+def test_hot_swap_under_concurrent_load(fleet, rng):
+    """Queries racing a rolling reload: every answer is pinned to ONE
+    version and bit-identical to THAT version's oracle — never a
+    cross-version merge, never an error."""
+    ids, tables, attrs, servers, _, bump, cli = fleet
+    oracle = {
+        servers[0]._engine.corpus.version: tables[1],
+    }
+    q = rng.standard_normal((3, 12)).astype(np.float32)
+    stop = threading.Event()
+    answers, errors = [], []
+
+    def pound():
+        while not stop.is_set():
+            try:
+                answers.append(cli.router.retrieve(q, 6))
+            except Exception as e:  # any leak fails the test below
+                errors.append(e)
+
+    threads = [threading.Thread(target=pound) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    bump(2)  # the loader now serves step 2: roll the fleet under load
+    reports = cli.reload_all()
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    v2 = {r["to_version"] for r in reports.values()}
+    assert len(v2) == 1 and all(r["swapped"] for r in reports.values())
+    oracle[v2.pop()] = tables[2]
+    seen = set()
+    for got_ids, got_sc, got_va, ver in answers:
+        seen.add(ver)
+        want = numpy_topk_oracle(ids, oracle[ver], q, 6)
+        for g, w in zip((got_ids, got_sc, got_va), want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    assert len(seen) == 2, "load never straddled the swap — racy test idle"
+    # post-swap steady state == the new table's oracle
+    got = cli.retrieve(q, 6)
+    want = numpy_topk_oracle(ids, tables[2], q, 6)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_version_pinning_and_skew_error(fleet, rng):
+    """After a swap the outgoing engine stays queryable as _prev (the
+    router's min-version pin path); an unknown pin answers the typed
+    'corpus version skew' verdict, not garbage."""
+    from euler_tpu.distributed.client import _Replica
+
+    ids, tables, attrs, servers, shard_addrs, bump, cli = fleet
+    v1 = servers[0]._engine.corpus.version
+    bump(2)
+    cli.reload_all()
+    v2 = servers[0]._engine.corpus.version
+    assert v1 < v2  # lexicographic == step order
+    rep = _Replica(*shard_addrs[0][0], shard=0)
+    try:
+        q = rng.standard_normal((2, 12)).astype(np.float32)
+        out = rep.call("retrieve", [q, 3, None, None, v1], timeout_s=5.0)
+        assert out[3] == v1  # served from _prev, version echoed
+        with pytest.raises(RpcError, match="corpus version skew"):
+            rep.call(
+                "retrieve", [q, 3, None, None, "v999999999999-deadbeef"],
+                timeout_s=5.0,
+            )
+    finally:
+        rep.drop()
+
+
+def test_replica_kill_failover_bit_identical(fleet, rng):
+    """One replica per shard drops dead mid-run (seeded chaos reset):
+    every query still answers, bit-identical to the fault-free oracle,
+    with ZERO typed-error leaks — pure transport failover."""
+    ids, tables, attrs, servers, shard_addrs, bump, cli = fleet
+    q = rng.standard_normal((4, 12)).astype(np.float32)
+    want = numpy_topk_oracle(ids, tables[1], q, 8)
+    plan = FaultPlan(
+        [
+            Fault(site="client", kind="reset", shard=s,
+                  replica=shard_addrs[s][0], after=1)
+            for s in range(2)
+        ],
+        seed=11,
+    )
+    chaos.install(plan)
+    try:
+        for _ in range(6):
+            got = cli.retrieve(q, 8)
+            for g, w in zip(got, want):
+                np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    finally:
+        chaos.uninstall()
+    assert sum(sh.retry_count for sh in cli.shards) > 0  # real failovers
+
+
+def test_hedged_query_stays_bitwise(rng):
+    """A slow replica trips the hedge; the answer must be the same bits
+    the fast path produces (replicas serve the same shard corpus)."""
+    ids, vecs, corpus = _corpus(rng, n=60, d=6)
+    corpora = {1: corpus}
+    servers, shard_addrs, _ = _fleet(corpora, num_parts=1, replicas=2)
+    cli = RetrievalClient(shard_addrs, hedge_ms=40.0)
+    plan = FaultPlan(
+        [Fault(site="client", kind="delay", delay_s=0.4,
+               replica=shard_addrs[0][0], op="retrieve")],
+        seed=3,
+    )
+    chaos.install(plan)
+    try:
+        q = rng.standard_normal((2, 6)).astype(np.float32)
+        got = cli.retrieve(q, 5)
+        want = numpy_topk_oracle(ids, vecs, q, 5)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+        assert cli.router.hedges >= 1
+    finally:
+        chaos.uninstall()
+        cli.close()
+        for s in servers:
+            s.stop()
+
+
+def test_tenant_quota_overload_is_typed(rng):
+    """A flooding tenant gets ITS OverloadError (typed, never transport-
+    retried); anonymous traffic and other tenants are untouched."""
+    ids, vecs, corpus = _corpus(rng, n=40, d=6)
+    quota = TenantQuota(qps=0.001, burst=1.0)  # one admit, then dry
+    servers, shard_addrs, _ = _fleet(
+        {1: corpus}, num_parts=1, replicas=1, tenant_quota=quota
+    )
+    cli = RetrievalClient(shard_addrs)
+    try:
+        q = rng.standard_normal((1, 6)).astype(np.float32)
+        got = cli.retrieve(q, 3, tenant="flood")  # spends the only token
+        with pytest.raises(OverloadError, match="flood"):
+            cli.retrieve(q, 3, tenant="flood")
+        # quota is per-tenant: others keep answering, bit-identically
+        for tenant in (None, "calm"):
+            got2 = cli.retrieve(q, 3, tenant=tenant)
+            for g, w in zip(got2, got):
+                np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    finally:
+        cli.close()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# the e2e recsys scenario
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_recsys_conditioned_training_to_filtered_serving(tmp_path):
+    """ISSUE 17's pinned scenario, end to end: an index-conditioned
+    sample over the served graph defines the active catalog; a TransX
+    run trains the entity embedding table and COMMITs retained
+    checkpoints; a 2-shard x 2-replica retrieval fleet serves
+    catalog-filtered top-K over that table — bit-identical to the NumPy
+    oracle before, across, and after a mid-run hot swap to a later
+    checkpoint, with a seeded replica kill riding the whole window and
+    ZERO typed-error leaks."""
+    from euler_tpu.distributed import connect
+    from euler_tpu.distributed.service import serve_shard
+    from euler_tpu.estimator import Estimator, EstimatorConfig
+    from euler_tpu.graph.builder import convert_json
+    from euler_tpu.models import TransX, kg_batches
+
+    # -- 1. the graph, served, with a conditioned catalog ---------------
+    # weight is the filterable popularity signal the catalog keys on
+    n = 48
+    base = {
+        "nodes": [
+            {
+                "id": i,
+                "type": i % 2,
+                "weight": float(1 + i % 5),
+                "features": [],
+            }
+            for i in range(1, n + 1)
+        ],
+        "edges": [
+            {"src": s, "dst": (s + off) % n + 1, "type": off % 2,
+             "weight": 1.0, "features": []}
+            for s in range(1, n + 1)
+            for off in (1, 3, 7)
+        ],
+    }
+    data = str(tmp_path / "graph")
+    convert_json(base, data, num_partitions=2)
+    reg = str(tmp_path / "reg")
+    services = [
+        serve_shard(data, p, registry_path=reg, native=False)
+        for p in range(2)
+    ]
+    retrieval_servers = []
+    cli = None
+    try:
+        g = connect(registry_path=reg, num_shards=2)
+        num_entities = len(base["nodes"])
+        # the catalog = every node the popularity condition admits; the
+        # conditioned SAMPLER must agree it only ever draws from it
+        dnf = [[("weight", "ge", 4.0)]]
+        catalog = np.asarray(
+            sorted(g.get_node_ids_by_condition(dnf)), np.uint64
+        )
+        assert 0 < len(catalog) < num_entities
+        srng = np.random.default_rng(5)
+        sampled = np.asarray(g.sample_node_with_condition(64, dnf, rng=srng))
+        assert np.isin(sampled, catalog.astype(sampled.dtype)).all()
+
+        # -- 2. train the model; retained checkpoints at two steps ------
+        model = TransX(
+            num_entities=num_entities, num_relations=2, dim=16
+        )
+        cfg = EstimatorConfig(
+            model_dir=str(tmp_path / "model"),
+            total_steps=6,
+            learning_rate=0.05,
+            log_steps=10**9,
+        )
+        est = Estimator(
+            model,
+            kg_batches(g, 16, num_negs=2, rng=np.random.default_rng(0)),
+            cfg,
+        )
+        est.train(total_steps=3, log=False, save=False)
+        est.save()  # COMMITted ckpt_3
+        step1 = est.step
+        est.train(total_steps=6, log=False, save=False)
+        est.save()  # COMMITted ckpt_6
+        step2 = est.step
+        assert step1 < step2
+
+        # -- 3. the retrieval fleet over the entity table ---------------
+        # the Embedding layer pads every table to a 128-row multiple, so
+        # the checkpoint holds TWO [128, 16] leaves (entity, relation) —
+        # leaf=0 (flax flattens alphabetically) picks the entity table.
+        # Rows 1..N are the graph nodes; row 0 and the pad tail only ever
+        # surface unfiltered, and this scenario always filters.
+        ids = np.arange(128, dtype=np.uint64)
+        attrs = {"in_catalog": np.isin(ids, catalog).astype(np.int64)}
+        model_dir = cfg.model_dir
+
+        def loader(source):
+            step = (source or {}).get("step")
+            return EmbeddingCorpus.from_checkpoint(
+                model_dir, ids, attrs=attrs, metric="cosine", step=step,
+                leaf=0,
+            )
+
+        shard_addrs = []
+        for part in range(2):
+            reps = []
+            for _ in range(2):
+                srv = RetrievalServer(
+                    loader=loader,
+                    part=part,
+                    num_parts=2,
+                    warm_k=8,
+                ).start()
+                retrieval_servers.append(srv)
+                reps.append((srv.host, srv.port))
+            shard_addrs.append(reps)
+        cli = RetrievalClient(shard_addrs)
+
+        def table(step):
+            params = CheckpointStore(model_dir).load(step)["params"]
+            return np.asarray(params[0], np.float32)  # the entity leaf
+
+        t1, t2 = table(step1), table(step2)
+        assert not np.array_equal(t1, t2)
+        mask = np.asarray(attrs["in_catalog"], bool)
+        # queries: the trained embeddings of the conditioned sample —
+        # "users who touched the catalog", straight from the model
+        q = t2[sampled[:5].astype(np.int64)].copy()
+
+        # kill one replica per shard for the WHOLE serving window
+        plan = FaultPlan(
+            [
+                Fault(site="client", kind="reset", shard=s,
+                      replica=shard_addrs[s][0])
+                for s in range(2)
+            ],
+            seed=23,
+        )
+        chaos.install(plan)
+        try:
+            got = cli.retrieve(q, 6, dnf=[[("in_catalog", "eq", 1)]])
+            want = numpy_topk_oracle(
+                ids, t2, q, 6, metric="cosine", mask=mask
+            )
+            for a, b in zip(got, want):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            # every answered id really is in the conditioned catalog
+            assert np.isin(
+                np.asarray(got[0])[np.asarray(got[2])], catalog
+            ).all()
+            # hot swap DOWN to the retained step1 checkpoint (the same
+            # verb that rolls forward), then back: parity at each rung
+            for step, tab in ((step1, t1), (step2, t2)):
+                reports = cli.reload_all(source={"step": step})
+                # the killed replica per shard reports its error; every
+                # reachable replica swaps — the roll still completes
+                swapped = [r for r in reports.values() if "swapped" in r]
+                dead = [r for r in reports.values() if "error" in r]
+                assert len(swapped) == 2 and len(dead) == 2, reports
+                assert all(r["swapped"] for r in swapped), reports
+                got = cli.retrieve(q, 6, dnf=[[("in_catalog", "eq", 1)]])
+                want = numpy_topk_oracle(
+                    ids, tab, q, 6, metric="cosine", mask=mask
+                )
+                for a, b in zip(got, want):
+                    np.testing.assert_array_equal(
+                        np.asarray(a), np.asarray(b)
+                    )
+        finally:
+            chaos.uninstall()
+        assert sum(sh.retry_count for sh in cli.shards) > 0  # kills bit
+    finally:
+        if cli is not None:
+            cli.close()
+        for s in retrieval_servers:
+            s.stop()
+        for s in services:
+            s.stop()
