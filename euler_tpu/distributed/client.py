@@ -350,10 +350,22 @@ class RemoteShard:
                     )
         return self._pool
 
-    def submit(self, op: str, values: list):
+    def submit(
+        self,
+        op: str,
+        values: list,
+        deadline_s: float | None = None,
+        prefer: tuple[str, int] | None = None,
+    ):
         """Async call: returns a concurrent.futures.Future of call()'s
         result, overlapping with other in-flight requests to this shard."""
-        return self._executor().submit(self.call, op, values)
+        if deadline_s is None and prefer is None:
+            # keep the 2-arg form when unpinned: callers (and tests)
+            # that stub call(op, values) keep working
+            return self._executor().submit(self.call, op, values)
+        return self._executor().submit(
+            self.call, op, values, deadline_s, prefer
+        )
 
     def close(self):
         """Stop the in-flight executor workers (idempotent)."""

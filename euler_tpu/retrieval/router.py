@@ -10,11 +10,16 @@ over the union corpus (pinned in tests/test_retrieval.py).
 Two reliability layers ride on top of `RemoteShard.call`'s built-in
 failover/quarantine/deadline envelope:
 
-  * Hedging (opt-in via `hedge_ms`): a shard answer still outstanding
-    after the hedge delay gets a second attempt preferring the next
-    replica in that shard's rotation; first success wins. Hedges are
-    capped by a `RetryBudget` so a systematically slow fleet degrades to
-    plain fan-out instead of doubling its own load. Typed server errors
+  * Hedging (opt-in via `hedge_ms`): the primary attempt is pinned to a
+    replica drawn from the shard's rotation and runs on the shard's OWN
+    executor (a leaf task — nesting it into the router pool would
+    deadlock the query path once outer fan-out tasks fill every worker);
+    an answer still outstanding after the hedge delay gets a second
+    attempt pinned to a DIFFERENT replica; first success wins. Hedges
+    are capped by a `RetryBudget` that un-hedged successes refill
+    (gRPC retry-throttle shape), so a systematically slow fleet degrades
+    to plain fan-out instead of doubling its own load — and recovers
+    hedging once it answers in time again. Typed server errors
     (`RpcError` subclasses) raise immediately — they are deterministic
     verdicts, not tail latency.
   * Version convergence: shard answers carry the corpus version they
@@ -82,23 +87,43 @@ class RetrievalRouter:
     def _shard_retrieve(self, sh, values, deadline_s):
         if self.hedge_ms is None or len(sh.replicas) < 2:
             return self._one(sh, values, deadline_s)
-        primary = self._pool.submit(self._one, sh, values, deadline_s)
+        # Primary + hedge go to the SHARD's own executor (leaf RPCs that
+        # submit nothing further), never self._pool: the router pool runs
+        # the outer _shard_retrieve tasks, and nesting blocking children
+        # into the same fixed-size pool deadlocks as soon as outer tasks
+        # fill every worker and wait on inner futures that can never be
+        # scheduled. The shard pool only ever runs tasks that complete on
+        # their own, so waiting on its futures always makes progress.
+        reps = sh.replicas  # one COW snapshot
+        prim_rep = sh._pick()  # honors quarantine, advances the rotation
+        prim_addr = (prim_rep.host, prim_rep.port)
+        primary = sh.submit(
+            "retrieve", list(values), deadline_s=deadline_s,
+            prefer=prim_addr,
+        )
         try:
-            return primary.result(timeout=self.hedge_ms / 1e3)
+            out = primary.result(timeout=self.hedge_ms / 1e3)
+            self._hedge_budget.on_success()  # un-hedged success refills
+            return out
         except concurrent.futures.TimeoutError:
             pass
         except RpcError:
             raise  # deterministic server verdict: hedging can't change it
         if not self._hedge_budget.try_spend():
-            return primary.result()
+            out = primary.result()
+            self._hedge_budget.on_success()  # slow but un-hedged: refill
+            return out
         self.hedges += 1
-        # the shard's round-robin cursor already moved past the primary's
-        # replica, so the cursor's current target is a DIFFERENT replica —
-        # prefer it explicitly for the hedge
-        reps = sh.replicas
-        nxt = reps[sh._rr % len(reps)]
-        hedge = self._pool.submit(
-            self._one, sh, values, deadline_s, (nxt.host, nxt.port)
+        # hedge a replica OTHER than the one the primary was pinned to —
+        # knowable exactly because the pin above froze the primary's
+        # target, instead of re-reading the shared round-robin cursor
+        # (bumped by every concurrent call, so under load it can point
+        # right back at the slow replica)
+        others = [r for r in reps if (r.host, r.port) != prim_addr]
+        nxt = others[self.hedges % len(others)] if others else prim_rep
+        hedge = sh.submit(
+            "retrieve", list(values), deadline_s=deadline_s,
+            prefer=(nxt.host, nxt.port),
         )
         pending = {primary, hedge}
         first_err: Exception | None = None

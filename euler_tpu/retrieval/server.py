@@ -274,7 +274,11 @@ class RetrievalServer:
             "swapped": new.corpus.version != old.corpus.version,
         }
         if pre is not None:
-            post = self._engine.retrieve(canary, canary_k, None)
+            # canary through `new` — the engine THIS reload published —
+            # not self._engine, which a concurrent reload may have flipped
+            # to a third version between our publish and this read (the
+            # parity verdict must describe our swap, not someone else's)
+            post = new.retrieve(canary, canary_k, None)
             report["canary_n"] = int(len(canary))
             report["canary_parity"] = bool(
                 all(np.array_equal(x, y) for x, y in zip(pre, post))

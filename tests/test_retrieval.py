@@ -395,6 +395,92 @@ def test_hedged_query_stays_bitwise(rng):
             s.stop()
 
 
+def test_concurrent_hedged_queries_never_deadlock(rng):
+    """REGRESSION: hedging used to nest primary/hedge tasks into the
+    router's own fixed-size pool — with >= 2 concurrent queries every
+    worker held an outer fan-out task blocked on an inner future that
+    could never be scheduled: a permanent wedge of the query path.
+    Primary + hedge now run on each shard's own executor (leaf tasks),
+    so concurrent hedged queries always drain, answers still bitwise."""
+    ids, vecs, corpus = _corpus(rng, n=80, d=6)
+    servers, shard_addrs, _ = _fleet({1: corpus}, num_parts=2, replicas=2)
+    cli = RetrievalClient(shard_addrs, hedge_ms=5.0)
+    try:
+        q = rng.standard_normal((2, 6)).astype(np.float32)
+        want = numpy_topk_oracle(ids, vecs, q, 5)
+        results, errors = [], []
+
+        def worker():
+            try:
+                results.append(cli.retrieve(q, 5))
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, daemon=True) for _ in range(6)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0  # one shared budget, not per-join
+        for t in threads:
+            t.join(timeout=max(deadline - time.monotonic(), 0.1))
+        assert not any(t.is_alive() for t in threads), "query path wedged"
+        assert not errors
+        assert len(results) == 6
+        for got in results:
+            for g, w in zip(got, want):
+                np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    finally:
+        cli.close()
+        for s in servers:
+            s.stop()
+
+
+def test_hedge_budget_refills_on_unhedged_success(rng):
+    """REGRESSION: the hedge token bucket was drain-only — after
+    `hedge_budget` hedges over the process lifetime, hedging silently
+    shut off forever even on a recovered fleet. Un-hedged successes now
+    refill it (gRPC retry-throttle shape), so a fleet that answers in
+    time again earns its hedges back."""
+    ids, vecs, corpus = _corpus(rng, n=60, d=6)
+    servers, shard_addrs, _ = _fleet({1: corpus}, num_parts=1, replicas=2)
+    cli = RetrievalClient(shard_addrs, hedge_ms=250.0, hedge_budget=1.0)
+    # every replica slow: whichever the primary pins, the hedge window
+    # elapses and the single token is spent
+    slow = FaultPlan(
+        [Fault(site="client", kind="delay", delay_s=0.6, op="retrieve")],
+        seed=3,
+    )
+    q = rng.standard_normal((1, 6)).astype(np.float32)
+    budget = cli.router._hedge_budget
+    try:
+        chaos.install(slow)
+        try:
+            cli.retrieve(q, 3)
+        finally:
+            chaos.uninstall()
+        assert cli.router.hedges == 1
+        assert budget.tokens < 1.0  # the only token is spent
+        # healthy traffic answers inside the hedge window: each un-hedged
+        # success refills a fraction until a whole token is back
+        for _ in range(64):
+            cli.retrieve(q, 3)
+            if budget.tokens >= 1.0:
+                break
+        assert budget.tokens >= 1.0
+        assert cli.router.hedges == 1  # refill spent nothing
+        chaos.install(slow)
+        try:
+            cli.retrieve(q, 3)
+        finally:
+            chaos.uninstall()
+        assert cli.router.hedges == 2  # the refilled token bought a hedge
+    finally:
+        cli.close()
+        for s in servers:
+            s.stop()
+
+
 def test_tenant_quota_overload_is_typed(rng):
     """A flooding tenant gets ITS OverloadError (typed, never transport-
     retried); anonymous traffic and other tenants are untouched."""
