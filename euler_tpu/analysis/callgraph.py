@@ -1,29 +1,55 @@
-"""Module-local call graph + thread-entry reachability.
+"""Call graphs + thread-entry reachability, module-local and repo-wide.
 
-Lock-discipline needs to know which functions can run on a thread that is
-NOT the constructing thread: anything referenced as a
-``threading.Thread(target=...)``, handed to an executor's ``submit``, or
-(transitively) called from one of those. Resolution is module-local and
-name-based:
+Two layers:
 
-  self.m()   -> "<Class>.m"   (same class)
-  f()        -> "f"           (module-level def)
-  cls.m()    -> "<Class>.m"
+``CallGraph`` (module-local, name-based) — what lock-discipline and
+unbounded-cache have always used: which functions can run on a thread
+that is NOT the constructing thread, resolved within one file.
 
-References count as edges even without a call — ``target=self._loop``
-and ``pool.submit(self._work)`` pass the function itself. Dynamic
-dispatch (``fn(*args)`` through a variable) is invisible, which is the
-right tradeoff: this feeds a heuristic race checker, and over-claiming
-reachability would drown real findings in noise.
+``ProjectCallGraph`` (repo-wide, import-resolved) — the interprocedural
+layer the concurrency checker family needs. Edges cross module
+boundaries through the alias-canonicalized symbol table
+(``from euler_tpu.x import f as g; g()`` resolves to ``euler_tpu/x.py::f``),
+thread/executor entry points propagate transitively across modules, and
+three per-function facts are exposed to checkers through ``core.py``:
+
+  * thread reachability — reachable from a ``threading.Thread`` target,
+    an executor submission, or a ``_PoolServer``-convention ``dispatch``
+    method (a class defining both ``dispatch`` and ``HANDLED_VERBS``).
+  * locks-held-on-entry — the intersection, over every known call site,
+    of the lock set syntactically held at the site plus the caller's own
+    entry locks (a fixpoint). This is how the ``_locked``-suffix calling
+    contract (``_merge_delta_locked``) becomes machine-checkable.
+  * owning executor set — for each bounded-executor binding
+    (``ThreadPoolExecutor`` / ``_DaemonExecutor``), which functions run
+    on its workers (transitively from everything submitted into it).
+
+Resolution stays name-based and deliberately under-approximate: dynamic
+dispatch through a variable is invisible, which is the right tradeoff —
+these facts feed heuristic race checkers, and over-claiming reachability
+or held locks would drown real findings in noise (reachability) or
+silently exempt real bugs (locks — which is why entry locks come from an
+intersection and default to "none held").
 """
 
 from __future__ import annotations
 
 import ast
+import os
+from dataclasses import dataclass
 
-from euler_tpu.analysis.symbols import ModuleSymbols, dotted
+from euler_tpu.analysis.symbols import LOCK_TYPES, ModuleSymbols, dotted
 
 _SUBMIT_METHODS = {"submit", "map", "apply_async"}
+
+# bounded-pool constructors: submitting into one of these from its own
+# worker and blocking on the future can deadlock once outer tasks fill
+# every worker (the PR 17 retrieval-router shape)
+EXECUTOR_TYPES = {
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+    "euler_tpu.distributed.client._DaemonExecutor",
+}
 
 
 def _function_index(
@@ -52,11 +78,9 @@ def _refs_in(fn: ast.FunctionDef, cls_name: str | None, index) -> set[str]:
             if cand in index:
                 refs.add(cand)
         elif d in index:
+            # covers plain module-level names AND explicitly spelled
+            # Class.method references alike — the index keys both
             refs.add(d)
-        elif "." in d:
-            # Class.method spelled explicitly
-            if d in index:
-                refs.add(d)
     return refs
 
 
@@ -118,3 +142,419 @@ class CallGraph:
 
     def thread_reachable(self) -> set[str]:
         return self.reachable(self.thread_targets())
+
+
+# -- repo-wide graph --------------------------------------------------------
+
+
+def module_name_of(relpath: str) -> str:
+    """Dotted module name for a repo-relative path
+    (``euler_tpu/retrieval/router.py`` -> ``euler_tpu.retrieval.router``,
+    packages collapse their ``__init__.py``)."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    p = p.replace(os.sep, "/")
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    elif p == "__init__":
+        p = ""
+    return p.replace("/", ".")
+
+
+def lock_token(mod, cls_name: str | None, expr: ast.AST) -> str | None:
+    """Stable identity of a lock expression, or None when the expression
+    is not a known lock binding. ``self._lock`` in class C ->
+    ``"C.self._lock"`` (instance-scoped: only meaningful while on the
+    same ``self``); a module-level binding -> ``"<relpath>:NAME"``."""
+    d = dotted(expr)
+    if not d:
+        return None
+    if d.startswith("self.") and cls_name:
+        attr = d[len("self."):]
+        if "." in attr:
+            return None
+        ctors = _self_ctors(mod, cls_name)
+        if ctors.get(attr) in LOCK_TYPES:
+            return f"{cls_name}.{d}"
+        return None
+    if mod.symbols.global_ctors.get(d) in LOCK_TYPES:
+        return f"{mod.relpath}:{d}"
+    return None
+
+
+def _self_ctors(mod, cls_name: str) -> dict[str, str]:
+    """Memoized ``self.<attr> -> canonical ctor`` map for one class."""
+    cache = getattr(mod, "_self_ctor_cache", None)
+    if cache is None:
+        cache = {}
+        mod._self_ctor_cache = cache
+    if cls_name not in cache:
+        cls = mod.symbols.classes.get(cls_name)
+        cache[cls_name] = (
+            mod.symbols.class_self_ctors(cls) if cls is not None else {}
+        )
+    return cache[cls_name]
+
+
+@dataclass(frozen=True)
+class ExecutorSubmit:
+    """One ``<executor>.submit(fn, ...)`` site on a known bounded pool."""
+
+    executor: str  # binding token, e.g. "euler_tpu/retrieval/router.py::RetrievalRouter._pool"
+    caller: str | None  # node id of the enclosing function, if any
+    target: str | None  # node id the submitted callable resolved to
+    relpath: str
+    line: int
+
+
+class ProjectCallGraph:
+    """Import-resolved call graph over every module in a Project.
+
+    Node ids are ``"<relpath>::<qualname>"`` — e.g.
+    ``"euler_tpu/retrieval/router.py::RetrievalRouter._fan_out"``.
+    """
+
+    def __init__(self, project):
+        self.project = project
+        self.mod_of_name: dict[str, object] = {}
+        for m in project.modules:
+            self.mod_of_name[module_name_of(m.relpath)] = m
+        self.index: dict[str, ast.AST] = {}
+        self.module_of: dict[str, object] = {}
+        self.cls_of: dict[str, str | None] = {}
+        self._local_index: dict[str, dict[str, ast.AST]] = {}
+        for m in project.modules:
+            idx = _function_index(m.tree)
+            self._local_index[m.relpath] = idx
+            for qual in idx:
+                nid = f"{m.relpath}::{qual}"
+                self.index[nid] = idx[qual]
+                self.module_of[nid] = m
+                cls, _, _name = qual.rpartition(".")
+                self.cls_of[nid] = cls or None
+        self.edges: dict[str, set[str]] = {n: set() for n in self.index}
+        # callee -> [(caller, locks-held-at-site, self_call)]
+        self._call_sites: dict[str, list] = {}
+        self.executor_submits: list[ExecutorSubmit] = []
+        self.entries: set[str] = set()
+        self._build_edges()
+        self._find_entries()
+        self.thread_reachable: set[str] = self.reachable(self.entries)
+        self._workers: dict[str, set[str]] = self._pool_workers()
+        self._owning: dict[str, set[str]] = {}
+        for token in sorted(self._workers):
+            for node in self._workers[token]:
+                self._owning.setdefault(node, set()).add(token)
+        self.entry_locks: dict[str, frozenset] = self._lock_fixpoint()
+
+    # -- queries checkers use -------------------------------------------
+
+    def node(self, relpath: str, qual: str) -> str:
+        return f"{relpath}::{qual}"
+
+    def reachable(self, roots: set[str]) -> set[str]:
+        seen = set(roots)
+        stack = sorted(roots)
+        while stack:
+            cur = stack.pop()
+            for nxt in sorted(self.edges.get(cur, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return seen
+
+    def owning_executors(self, node: str) -> set[str]:
+        """Bounded-executor bindings whose workers can run `node`."""
+        return self._owning.get(node, set())
+
+    def pool_workers(self, token: str) -> set[str]:
+        return self._workers.get(token, set())
+
+    def locks_on_entry(self, node: str) -> frozenset:
+        """Locks provably held at EVERY known call site of `node`
+        (empty for entry points and for functions never called from
+        analyzed code — "no locks" is the safe default both ways)."""
+        return self.entry_locks.get(node, frozenset())
+
+    # -- resolution ------------------------------------------------------
+
+    def _resolve_canonical(self, canon: str) -> str | None:
+        """``euler_tpu.distributed.errors.NotPrimaryError.parse_primary``
+        -> its node id, trying the longest module-name prefix first."""
+        parts = canon.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mname = ".".join(parts[:cut])
+            m = self.mod_of_name.get(mname)
+            if m is None:
+                continue
+            rest = ".".join(parts[cut:])
+            nid = f"{m.relpath}::{rest}"
+            if nid in self.index:
+                return nid
+            ctor = f"{m.relpath}::{rest}.__init__"
+            if ctor in self.index:
+                return ctor
+            return None
+        return None
+
+    def resolve(self, mod, cls_name: str | None, d: str):
+        """Resolve a dotted reference in (module, class) context.
+        Returns (node_id | None, is_self_call)."""
+        rel = mod.relpath
+        idx = self._local_index[rel]
+        if d.startswith("self.") and cls_name:
+            rest = d[len("self."):]
+            if "." not in rest:
+                if f"{cls_name}.{rest}" in idx:
+                    return f"{rel}::{cls_name}.{rest}", True
+                return None, False
+            attr, _, meth = rest.partition(".")
+            if "." in meth:
+                return None, False
+            ctor = _self_ctors(mod, cls_name).get(attr)
+            if ctor:
+                # method on a ctor-typed attribute (self._pool.submit)
+                return self._resolve_canonical(f"{ctor}.{meth}"), False
+            return None, False
+        if d in idx:
+            return f"{rel}::{d}", False
+        if d in mod.symbols.classes:
+            ctor = f"{rel}::{d}.__init__"
+            return (ctor if ctor in self.index else None), False
+        canon = mod.symbols.canonical(d)
+        if canon and canon != d:
+            return self._resolve_canonical(canon), False
+        if canon and "." in canon:
+            return self._resolve_canonical(canon), False
+        return None, False
+
+    def executor_binding(self, mod, cls_name: str | None, d: str) -> str | None:
+        """Token of the bounded-executor binding a dotted receiver names,
+        or None (``self._pool`` -> ``"<relpath>::<Class>._pool"``)."""
+        if d.startswith("self.") and cls_name:
+            attr = d[len("self."):]
+            if "." not in attr:
+                if _self_ctors(mod, cls_name).get(attr) in EXECUTOR_TYPES:
+                    return f"{mod.relpath}::{cls_name}.{attr}"
+            return None
+        if mod.symbols.global_ctors.get(d) in EXECUTOR_TYPES:
+            return f"{mod.relpath}::{d}"
+        return None
+
+    # -- construction ----------------------------------------------------
+
+    def _build_edges(self):
+        for nid in sorted(self.index):
+            fn = self.index[nid]
+            mod = self.module_of[nid]
+            cls = self.cls_of[nid]
+            self._walk_fn(nid, fn, mod, cls)
+
+    def _walk_fn(self, nid, fn, mod, cls):
+        """One pass over a function body: edges + per-site lock context +
+        executor submit sites."""
+
+        def add_ref(d: str, locks: tuple):
+            target, self_call = self.resolve(mod, cls, d)
+            if target is None or target == nid:
+                return
+            self.edges[nid].add(target)
+            self._call_sites.setdefault(target, []).append(
+                (nid, frozenset(locks), self_call)
+            )
+
+        def scan_expr(node, locks):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Name, ast.Attribute)):
+                    d = dotted(sub)
+                    if d:
+                        add_ref(d, locks)
+                elif isinstance(sub, ast.Call):
+                    self._note_submit(sub, nid, mod, cls)
+
+        def visit(stmts, locks):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    now_held = list(locks)
+                    for item in stmt.items:
+                        scan_expr(item.context_expr, locks)
+                        tok = lock_token(mod, cls, item.context_expr)
+                        if tok:
+                            now_held.append(tok)
+                    visit(stmt.body, tuple(now_held))
+                    continue
+                # statement-level expressions under the current lock set
+                for field_name, value in ast.iter_fields(stmt):
+                    if isinstance(value, ast.expr):
+                        scan_expr(value, locks)
+                    elif isinstance(value, list):
+                        for v in value:
+                            if isinstance(v, ast.expr):
+                                scan_expr(v, locks)
+                            elif isinstance(v, ast.excepthandler):
+                                visit(v.body, locks)
+                            elif isinstance(v, (ast.stmt,)):
+                                pass  # handled below via body recursion
+                # nested statement blocks keep the same lock set
+                for block in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, block, None)
+                    if sub and all(isinstance(s, ast.stmt) for s in sub):
+                        if isinstance(
+                            stmt,
+                            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                        ):
+                            # nested defs run later; their refs still
+                            # count as edges but carry no lock context
+                            visit(sub, ())
+                        else:
+                            visit(sub, locks)
+
+        visit(fn.body, ())
+
+    def _note_submit(self, call: ast.Call, nid, mod, cls):
+        d = dotted(call.func) or ""
+        base, _, meth = d.rpartition(".")
+        if meth not in _SUBMIT_METHODS or not base or not call.args:
+            return
+        token = self.executor_binding(mod, cls, base)
+        if token is None:
+            return
+        target = None
+        ref = dotted(call.args[0])
+        if ref:
+            target, _self_call = self.resolve(mod, cls, ref)
+        self.executor_submits.append(
+            ExecutorSubmit(token, nid, target, mod.relpath, call.lineno)
+        )
+
+    def _enclosing_context(self, mod, node):
+        """(node_id | None, class name | None) for an arbitrary AST node."""
+        qual = mod.qualname_of(node)
+        if qual == "<module>":
+            return None, None
+        nid = f"{mod.relpath}::{qual}"
+        if nid in self.index:
+            return nid, self.cls_of[nid]
+        head = qual.split(".")[0]
+        cls = head if head in mod.symbols.classes else None
+        return None, cls
+
+    def _find_entries(self):
+        for m in self.project.modules:
+            # _PoolServer service convention: dispatch() runs on pool
+            # worker threads of the server that wraps the service
+            for cls_name, cls in sorted(m.symbols.classes.items()):
+                has_verbs = any(
+                    isinstance(s, (ast.Assign, ast.AnnAssign))
+                    and any(
+                        dotted(t) == "HANDLED_VERBS"
+                        for t in (
+                            s.targets
+                            if isinstance(s, ast.Assign)
+                            else [s.target]
+                        )
+                    )
+                    for s in cls.body
+                )
+                nid = f"{m.relpath}::{cls_name}.dispatch"
+                if has_verbs and nid in self.index:
+                    self.entries.add(nid)
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = m.symbols.canonical_of(node.func) or ""
+                d = dotted(node.func) or ""
+                candidates: list[ast.AST] = []
+                if canon == "threading.Thread" or canon.endswith(
+                    ".threading.Thread"
+                ):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            candidates.append(kw.value)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SUBMIT_METHODS
+                    and node.args
+                ):
+                    # attr-name match, not dotted(): the receiver may be
+                    # a call (`self._executor().submit(self.call, ...)`)
+                    candidates.append(node.args[0])
+                if not candidates:
+                    continue
+                enc_nid, cls = self._enclosing_context(m, node)
+                for cand in candidates:
+                    ref = dotted(cand)
+                    if not ref:
+                        continue
+                    target, _self_call = self.resolve(m, cls, ref)
+                    if target is not None:
+                        self.entries.add(target)
+                    elif ref.startswith("self."):
+                        # unknown enclosing class (nested def): fall back
+                        # to the module-local suffix match
+                        attr = ref[len("self."):]
+                        for qual in self._local_index[m.relpath]:
+                            if qual.endswith(f".{attr}"):
+                                self.entries.add(f"{m.relpath}::{qual}")
+                    elif (
+                        isinstance(cand, ast.Name)
+                        and enc_nid is not None
+                    ):
+                        # target is a local (`for name, fn in ...:
+                        # Thread(target=fn)`): every method the spawning
+                        # function references is a candidate target
+                        for sub in ast.walk(self.index[enc_nid]):
+                            if not isinstance(sub, ast.Attribute):
+                                continue
+                            sd = dotted(sub)
+                            if not sd or not sd.startswith("self."):
+                                continue
+                            t2, _ = self.resolve(m, cls, sd)
+                            if t2 is not None:
+                                self.entries.add(t2)
+
+    def _pool_workers(self) -> dict[str, set[str]]:
+        roots: dict[str, set[str]] = {}
+        for sub in self.executor_submits:
+            if sub.target is not None:
+                roots.setdefault(sub.executor, set()).add(sub.target)
+        return {
+            token: self.reachable(targets)
+            for token, targets in sorted(roots.items())
+        }
+
+    def _lock_fixpoint(self) -> dict[str, frozenset]:
+        """Locks held at every known call site, to a fixpoint. Entry
+        points are pinned to "none" (they can be called bare); instance
+        lock tokens only survive self-calls (same object)."""
+        TOP = None  # lattice top: "not yet constrained"
+        state: dict[str, object] = {n: TOP for n in self.index}
+        for n in self.entries:
+            state[n] = frozenset()
+        # chaotic iteration: recompute each callee's entry set from the
+        # current caller states until stable (bounded — recursion cycles
+        # could in principle ping-pong, and imprecision there is fine)
+        for _ in range(len(self.index) + 1):
+            changed = False
+            for callee in sorted(self._call_sites):
+                if callee in self.entries or callee not in state:
+                    continue
+                acc = TOP
+                for caller, site_locks, self_call in self._call_sites[callee]:
+                    caller_locks = state.get(caller)
+                    if not isinstance(caller_locks, frozenset):
+                        caller_locks = frozenset()
+                    held = caller_locks | site_locks
+                    if not self_call:
+                        held = frozenset(
+                            t for t in held if ".self." not in t
+                        )
+                    acc = held if acc is TOP else (acc & held)
+                if acc is not TOP and state[callee] != acc:
+                    state[callee] = acc
+                    changed = True
+            if not changed:
+                break
+        return {
+            n: (v if isinstance(v, frozenset) else frozenset())
+            for n, v in state.items()
+        }

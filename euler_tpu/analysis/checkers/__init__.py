@@ -2,11 +2,15 @@
 built-in checkers with euler_tpu.analysis.core.CHECKERS."""
 
 from euler_tpu.analysis.checkers import (  # noqa: F401
+    blocking_under_lock,
     borrowed_buffer_escape,
     determinism,
     durable_write,
+    executor_deadlock,
+    hot_swap_reread,
     jit_purity,
     lock_discipline,
+    typed_error_retry,
     unbounded_cache,
     wire_protocol,
 )

@@ -1,0 +1,171 @@
+"""blocking-under-lock: calls that park the thread while a lock every
+other worker needs stays held.
+
+Check id:
+  lock-blocking-call — inside a held-lock region of thread-reachable
+                       code (repo-wide call graph: Thread targets,
+                       executor submissions, ``dispatch`` handlers, and
+                       everything they transitively call — including
+                       locks the function holds *on entry* per the
+                       ``_locked``-suffix calling contract), a call that
+                       blocks on something slower than memory:
+
+                         * ``time.sleep``
+                         * ``future.result()`` / ``concurrent.futures.wait``
+                         * ``<event-or-future>.wait(...)``
+                         * socket ops (``.recv`` / ``.sendall`` /
+                           ``.connect`` / ``socket.create_connection``)
+                         * wire-verb client calls — ``x.call("verb", ...)``
+                           / ``x._call("verb", ...)`` with a literal verb
+                         * ``os.fsync``
+
+Why: a lock held across a blocking call turns one slow peer (or one slow
+disk) into a stall for EVERY worker that touches the lock — and when the
+blocked thing itself needs a worker, into a deadlock. The repo-wide
+discipline is fetch-outside-lock: do the blocking work on locals, take
+the lock only to swap the result in (client.py's quarantine writes and
+``unit_edge_weights`` are the reference shape).
+
+Deliberately NOT flagged:
+  - ``cond.wait()`` while holding *that same condition* — Condition.wait
+    releases the lock it waits on; that is the designed long-poll shape
+    (``after_commit`` / ``wait_for_append`` in replication.py).
+  - ``os.fsync`` while a ``*sync*``-named lock is held — the WAL's
+    group-commit idiom: the dedicated sync lock's whole job is to order
+    fsyncs, and whoever holds it fsyncs for everyone. Holding a generic
+    data lock across fsync is still flagged.
+
+Suppress only when the "lock" guards the blocking resource itself (a
+connection-owning mutex serializing one socket, for example) and no
+request-path reader shares it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.callgraph import lock_token
+from euler_tpu.analysis.core import Checker, Finding, register
+from euler_tpu.analysis.symbols import dotted
+
+CHECKER = "blocking-under-lock"
+CHECK = "lock-blocking-call"
+
+_SOCKET_METHODS = {"recv", "recv_into", "sendall", "connect", "accept"}
+_WIRE_CALL_METHODS = {"call", "_call"}
+
+
+def _describe_block(node: ast.Call, mod, held: tuple) -> str | None:
+    """What this call blocks on, or None when it does not block."""
+    d = dotted(node.func) or ""
+    canon = mod.symbols.canonical_of(node.func) or ""
+    meth = d.rpartition(".")[2]
+    if canon == "time.sleep":
+        return "time.sleep"
+    if canon == "concurrent.futures.wait":
+        return "concurrent.futures.wait"
+    if canon == "os.fsync":
+        if any("sync" in tok.lower() for tok in held):
+            return None  # group-commit idiom: the sync lock orders fsyncs
+        return "os.fsync"
+    if canon == "socket.create_connection":
+        return "socket.create_connection"
+    if meth == "result" and "." in d:
+        return f"{d}(...) (future wait)"
+    if meth == "wait" and "." in d:
+        return f"{d}(...) (wait)"
+    if meth in _SOCKET_METHODS and "." in d:
+        return f"{d}(...) (socket)"
+    if (
+        meth in _WIRE_CALL_METHODS
+        and "." in d
+        and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+    ):
+        return f"{d}({node.args[0].value!r}, ...) (wire RPC)"
+    return None
+
+
+def _scan_fn(nid: str, fn, mod, cls, entry_locks, findings):
+    qual = nid.split("::", 1)[1]
+
+    def visit(stmts, held: tuple):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now = list(held)
+                for item in stmt.items:
+                    scan_expr(item.context_expr, held)
+                    tok = lock_token(mod, cls, item.context_expr)
+                    if tok:
+                        now.append(tok)
+                visit(stmt.body, tuple(now))
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs run later, not under these locks
+            for _name, value in ast.iter_fields(stmt):
+                if isinstance(value, ast.expr):
+                    scan_expr(value, held)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            scan_expr(v, held)
+                        elif isinstance(v, ast.excepthandler):
+                            visit(v.body, held)
+            for block in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, block, None)
+                if sub and all(isinstance(s, ast.stmt) for s in sub):
+                    visit(sub, held)
+
+    def scan_expr(expr, held: tuple):
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda,)):
+                continue
+            if not isinstance(node, ast.Call) or not held:
+                continue
+            d = dotted(node.func) or ""
+            if d.rpartition(".")[2] == "wait" and "." in d:
+                # Condition.wait on the held condition RELEASES it — the
+                # sanctioned long-poll shape
+                recv = node.func.value if isinstance(
+                    node.func, ast.Attribute
+                ) else None
+                tok = lock_token(mod, cls, recv) if recv is not None else None
+                if tok is not None and tok in held:
+                    continue
+            what = _describe_block(node, mod, held)
+            if what is None:
+                continue
+            locks = ", ".join(sorted(set(held)))
+            findings.append(
+                Finding(
+                    CHECK,
+                    CHECKER,
+                    mod.relpath,
+                    node.lineno,
+                    qual,
+                    f"blocking call {what} while holding {locks} on a"
+                    " thread-reachable path — one slow peer/disk stalls"
+                    " every worker that needs the lock. Do the blocking"
+                    " work on locals and take the lock only to swap the"
+                    " result in (fetch-outside-lock), or move the wait to"
+                    " a Condition on this lock",
+                )
+            )
+
+    visit(fn.body, tuple(sorted(entry_locks)))
+
+
+@register
+class BlockingUnderLockChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        cg = project.callgraph
+        findings: list[Finding] = []
+        for nid in sorted(cg.thread_reachable):
+            fn = cg.index[nid]
+            mod = cg.module_of[nid]
+            cls = cg.cls_of[nid]
+            _scan_fn(nid, fn, mod, cls, cg.locks_on_entry(nid), findings)
+        return findings

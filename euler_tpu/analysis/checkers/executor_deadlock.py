@@ -1,0 +1,96 @@
+"""executor-deadlock: a bounded pool's own workers submitting back into
+that pool and blocking on the result.
+
+Check id:
+  executor-self-submit — a function that RUNS on a bounded executor's
+                         workers (it was submitted into the executor, or
+                         is transitively called from something that was)
+                         submits more work into the SAME executor and
+                         blocks on a future (``.result(...)`` /
+                         ``concurrent.futures.wait(...)``) in the same
+                         body.
+
+Why this deadlocks: every worker of a fixed-size pool can be occupied by
+an outer task; each outer task then enqueues an inner task into the same
+pool and parks in ``.result()``. The inner tasks can never be scheduled
+— all workers are parked waiting for them. This is exactly the PR 17
+retrieval-router bug: ``_fan_out`` filled the router pool with
+``_shard_retrieve`` tasks, and ``_shard_retrieve`` submitted its
+primary/hedge attempts into ``self._pool`` and waited. Nothing fails
+fast; the query path just stops, under load only.
+
+The executor identity is the *binding* (``self._pool`` of one class, or
+a module-level pool), resolved through the repo-wide call graph's
+alias-canonicalized constructor table — so the cross-module case
+(``_DaemonExecutor`` imported from ``distributed.client``) resolves the
+same as a local ``ThreadPoolExecutor``.
+
+The good form (and the shipped fix): inner attempts go to a DIFFERENT
+executor whose tasks are leaves — the shard's own RPC pool — so waiting
+on them always makes progress.
+
+Suppress only when the pool is provably unbounded or the submit is
+fire-and-forget (nothing in the worker ever blocks on the future).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.core import Checker, Finding, register
+from euler_tpu.analysis.symbols import dotted
+
+CHECKER = "executor-deadlock"
+
+
+def _block_site(fn: ast.AST, mod) -> int | None:
+    """Line of the first future-blocking call in `fn`, else None."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func) or ""
+        if d.endswith(".result"):
+            return node.lineno
+        canon = mod.symbols.canonical_of(node.func) or ""
+        if canon == "concurrent.futures.wait":
+            return node.lineno
+    return None
+
+
+@register
+class ExecutorDeadlockChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        cg = project.callgraph
+        findings: list[Finding] = []
+        for sub in cg.executor_submits:
+            if sub.caller is None:
+                continue
+            if sub.caller not in cg.pool_workers(sub.executor):
+                continue
+            mod = cg.module_of[sub.caller]
+            fn = cg.index[sub.caller]
+            block_line = _block_site(fn, mod)
+            if block_line is None:
+                continue  # fire-and-forget re-submit: queues, not deadlocks
+            qual = sub.caller.split("::", 1)[1]
+            pool = sub.executor.split("::", 1)[1]
+            findings.append(
+                Finding(
+                    "executor-self-submit",
+                    CHECKER,
+                    sub.relpath,
+                    sub.line,
+                    qual,
+                    f"`{qual}` runs on `{pool}`'s own workers and submits"
+                    f" back into `{pool}` here, then blocks on a future"
+                    f" (line {block_line}) — once outer tasks fill every"
+                    " worker, the inner tasks can never be scheduled and"
+                    " the pool deadlocks. Submit leaf work to a different"
+                    " executor (the PR 17 fix: the shard's own RPC pool)"
+                    " or restructure so workers never wait on their own"
+                    " pool",
+                )
+            )
+        return findings

@@ -1,0 +1,213 @@
+"""hot-swap-reread: a swap-published reference read more than once per
+request instead of bound once.
+
+Check id:
+  hot-swap-reread — an attribute published by single-reference swap
+                    (assigned whole-object under a held lock outside
+                    ``__init__``: ``self._engine``, ``self.store``,
+                    ``RemoteShard.replicas``) is LOADED two or more
+                    times, outside any lock, in one thread-reachable
+                    function — either on ``self`` in the owning class or
+                    on the same local handle anywhere in the repo.
+
+Why: the whole point of the one-reference-publish discipline is that a
+reader binds the reference ONCE and gets a coherent immutable snapshot;
+every extra unlocked read is a chance to observe a DIFFERENT object when
+a concurrent swap lands between the reads. That is the PR 17 canary race
+(``_reload`` re-read ``self._engine`` after publishing and reported
+parity against someone else's swap) and the hedge-target race
+(re-reading ``sh.replicas`` mid-call can hedge against a rotation the
+primary pick never saw).
+
+The good form: ``eng = self._engine`` / ``reps = sh.replicas`` at the
+top of the request, every later use through the local. Reads under ANY
+held lock are exempt (the lock orders them against the swap), as are
+reads in functions whose every call site provably holds a lock (the
+``_locked``-suffix contract, via locks-held-on-entry).
+
+Suppress only when the re-read is the point — e.g. a retry loop that
+WANTS to observe the newest published version each attempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.callgraph import lock_token
+from euler_tpu.analysis.core import Checker, Finding, register
+from euler_tpu.analysis.symbols import dotted
+
+CHECKER = "hot-swap-reread"
+
+_INIT_FUNCS = {"__init__", "__new__", "__post_init__"}
+# swapped values are object references, not flags/counters
+_SWAP_VALUE_TYPES = (ast.Name, ast.Attribute, ast.Call, ast.BinOp, ast.Tuple)
+
+
+def _swap_published(project, cg):
+    """(relpath, cls) -> set of swap-published attr names, plus the
+    project-wide name set for the cross-module half."""
+    by_class: dict[tuple, set] = {}
+    for m in project.modules:
+        for cls_name, cls in sorted(m.symbols.classes.items()):
+            for sub in cls.body:
+                if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if sub.name in _INIT_FUNCS:
+                    continue
+                nid = f"{m.relpath}::{cls_name}.{sub.name}"
+                entry = cg.locks_on_entry(nid) if nid in cg.index else frozenset()
+                for assign, held in _assigns_with_locks(sub, m, cls_name, entry):
+                    if not held:
+                        continue
+                    if not isinstance(assign.value, _SWAP_VALUE_TYPES):
+                        continue
+                    for t in assign.targets:
+                        d = dotted(t)
+                        if d and d.startswith("self.") and d.count(".") == 1:
+                            by_class.setdefault(
+                                (m.relpath, cls_name), set()
+                            ).add(d[len("self."):])
+    return by_class
+
+
+def _assigns_with_locks(fn, mod, cls_name, entry_locks):
+    """Yield (Assign, locks-held) for every assignment in `fn`."""
+    out = []
+
+    def visit(stmts, held):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now = list(held)
+                for item in stmt.items:
+                    tok = lock_token(mod, cls_name, item.context_expr)
+                    if tok:
+                        now.append(tok)
+                visit(stmt.body, tuple(now))
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                out.append((stmt, held))
+            for _name, value in ast.iter_fields(stmt):
+                if isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.excepthandler):
+                            visit(v.body, held)
+            for block in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, block, None)
+                if sub and all(isinstance(s, ast.stmt) for s in sub):
+                    visit(sub, held)
+
+    visit(fn.body, tuple(sorted(entry_locks)))
+    return out
+
+
+def _unlocked_reads(fn, mod, cls_name, entry_locks, want):
+    """(token, line) per unlocked Load of a watched reference.
+    `want(base_dotted, attr) -> token | None` decides what is watched."""
+    reads: list[tuple[str, int]] = []
+
+    def scan_expr(expr, held):
+        if held:
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not isinstance(node.ctx, ast.Load):
+                continue
+            base = dotted(node.value)
+            if base is None:
+                continue
+            token = want(base, node.attr)
+            if token is not None:
+                reads.append((token, node.lineno))
+
+    def visit(stmts, held):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                now = list(held)
+                for item in stmt.items:
+                    scan_expr(item.context_expr, held)
+                    tok = lock_token(mod, cls_name, item.context_expr)
+                    if tok:
+                        now.append(tok)
+                visit(stmt.body, tuple(now))
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for _name, value in ast.iter_fields(stmt):
+                if isinstance(value, ast.expr):
+                    scan_expr(value, held)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            scan_expr(v, held)
+                        elif isinstance(v, ast.excepthandler):
+                            visit(v.body, held)
+            for block in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, block, None)
+                if sub and all(isinstance(s, ast.stmt) for s in sub):
+                    visit(sub, held)
+
+    visit(fn.body, tuple(sorted(entry_locks)))
+    return reads
+
+
+@register
+class HotSwapRereadChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        cg = project.callgraph
+        by_class = _swap_published(project, cg)
+        # cross-module half: swap attr names anywhere in the repo
+        swap_names: set[str] = set()
+        for attrs in by_class.values():
+            swap_names |= attrs
+        findings: list[Finding] = []
+        for nid in sorted(cg.thread_reachable):
+            fn = cg.index[nid]
+            mod = cg.module_of[nid]
+            cls = cg.cls_of[nid]
+            qual = nid.split("::", 1)[1]
+            if qual.rpartition(".")[2] in _INIT_FUNCS:
+                continue
+            own = by_class.get((mod.relpath, cls), set()) if cls else set()
+
+            def want(base, attr, own=own):
+                if base == "self":
+                    return f"self.{attr}" if attr in own else None
+                if "." in base or base == "cls":
+                    return None  # only direct local handles
+                if base in mod.symbols.aliases:
+                    return None  # module alias, not an object
+                return f"{base}.{attr}" if attr in swap_names else None
+
+            reads = _unlocked_reads(fn, mod, cls, cg.locks_on_entry(nid), want)
+            seen: dict[str, int] = {}
+            flagged: set[str] = set()
+            for token, line in reads:
+                if token in flagged:
+                    continue
+                if token in seen:
+                    flagged.add(token)
+                    findings.append(
+                        Finding(
+                            CHECKER,
+                            CHECKER,
+                            mod.relpath,
+                            line,
+                            qual,
+                            f"`{token}` is a swap-published reference read"
+                            f" again here (first read line {seen[token]})"
+                            " outside any lock — a concurrent swap between"
+                            " the reads hands this request TWO different"
+                            " snapshots (the PR 17 canary-race shape). Bind"
+                            " it once at the top of the request and use the"
+                            " local everywhere",
+                        )
+                    )
+                else:
+                    seen[token] = line
+        return findings

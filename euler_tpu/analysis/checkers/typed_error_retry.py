@@ -1,0 +1,252 @@
+"""typed-error-retry: transport-retrying a typed server verdict.
+
+Check ids:
+  typed-error-retry       — an ``except`` arm catching ONLY typed wire
+                            errors (``RpcError`` / ``NotPrimaryError`` /
+                            ``OverloadError`` / ``DeadlineExceeded*``,
+                            alias-resolved to distributed/errors.py)
+                            that re-issues the call — a wire-verb
+                            ``.call``/``._call``/``.submit`` in the
+                            handler body, or a bare ``continue`` back
+                            into a loop that issues one — while the
+                            handler neither re-raises on any path nor
+                            consults the exception it caught.
+  retry-budget-drain-only — a ``RetryBudget`` binding whose tokens are
+                            only ever spent (``try_spend``) and never
+                            refilled (``on_success``): the gRPC
+                            retry-throttle shape requires successes to
+                            pay tokens back, or one slow burst disables
+                            hedging/retry for the life of the process.
+
+Why: typed errors are deterministic server VERDICTS — the same answer on
+any replica, any number of times (OPERATIONS.md failure semantics).
+Blindly re-issuing the call turns a clean verdict into duplicated load
+and, for mutations, a correctness hazard. The sanctioned idioms all
+either consult the verdict or keep a raise path, and both exempt the
+arm here:
+
+  * re-route on the address a ``NotPrimaryError`` names
+    (``parse_primary`` — writer.py)
+  * sticky capability downgrade after checking ``"unknown op" in str(e)``
+    (client.py, analytics)
+  * re-pin and re-fan-out after checking for ``"corpus version skew"``
+    (retrieval router)
+
+Transport faults (``OSError`` / ``ConnectionError``) ARE the retryable
+class; an arm that catches them alongside typed errors is mixed-policy
+code the checker leaves alone.
+
+Suppress only when the re-issue provably targets a different verb or a
+different argument set (in which case: say so in the reason).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from euler_tpu.analysis.core import Checker, Finding, register
+from euler_tpu.analysis.symbols import dotted
+
+CHECKER = "typed-error-retry"
+
+_ERRMOD = "euler_tpu.distributed.errors"
+TYPED_ERRORS = {
+    f"{_ERRMOD}.RpcError",
+    f"{_ERRMOD}.DeadlineExceeded",
+    f"{_ERRMOD}.DeadlineExceededError",
+    f"{_ERRMOD}.OverloadError",
+    f"{_ERRMOD}.NotPrimaryError",
+}
+_REISSUE_METHODS = {"call", "_call", "submit"}
+_BUDGET_CTOR = "euler_tpu.distributed.retry.RetryBudget"
+
+
+def _typed_only(mod, type_node) -> bool:
+    """True when the except arm's type set is entirely typed wire errors."""
+    if type_node is None:
+        return False
+    elts = (
+        list(type_node.elts)
+        if isinstance(type_node, ast.Tuple)
+        else [type_node]
+    )
+    if not elts:
+        return False
+    for e in elts:
+        canon = mod.symbols.canonical_of(e)
+        if canon not in TYPED_ERRORS:
+            return False
+    return True
+
+
+def _reissue_call(body) -> ast.Call | None:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                if "." in d and d.rpartition(".")[2] in _REISSUE_METHODS:
+                    return node
+    return None
+
+
+def _scan_handlers(mod, findings):
+    # loop stack so a bare `continue` in a handler can be traced to the
+    # call the enclosing loop re-issues
+    def visit(stmts, loops):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(stmt.body, ())
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                visit(stmt.body, ())
+                continue
+            is_loop = isinstance(stmt, (ast.For, ast.While, ast.AsyncFor))
+            inner = loops + (stmt,) if is_loop else loops
+            if isinstance(stmt, ast.Try):
+                visit(stmt.body, inner)
+                for h in stmt.handlers:
+                    _check_handler(h, inner)
+                    visit(h.body, inner)
+                visit(stmt.orelse, inner)
+                visit(stmt.finalbody, inner)
+                continue
+            for block in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, block, None)
+                if sub and all(isinstance(s, ast.stmt) for s in sub):
+                    visit(sub, inner)
+
+    def _check_handler(h: ast.excepthandler, loops):
+        if not _typed_only(mod, h.type):
+            return
+        # a raise on any path keeps the verdict fatal; consulting the
+        # bound exception means the handler is policy, not a blind retry
+        if any(isinstance(n, ast.Raise) for n in ast.walk(h)):
+            return
+        if h.name and any(
+            isinstance(n, ast.Name)
+            and n.id == h.name
+            and isinstance(n.ctx, ast.Load)
+            for n in ast.walk(h)
+        ):
+            return
+        call = _reissue_call(h.body)
+        via_continue = False
+        if call is None and loops:
+            has_continue = any(
+                isinstance(n, ast.Continue) for n in ast.walk(h)
+            )
+            if has_continue:
+                call = _reissue_call(loops[-1].body)
+                via_continue = call is not None
+        if call is None:
+            return
+        caught = (
+            mod.symbols.canonical_of(
+                h.type.elts[0] if isinstance(h.type, ast.Tuple) else h.type
+            )
+            or "?"
+        ).rpartition(".")[2]
+        how = (
+            "loops back into the call via `continue`"
+            if via_continue
+            else f"re-issues `{dotted(call.func)}` in the handler"
+        )
+        findings.append(
+            Finding(
+                CHECKER,
+                CHECKER,
+                mod.relpath,
+                h.lineno,
+                mod.qualname_of(h),
+                f"except arm catches typed `{caught}` and {how} without"
+                " re-raising or consulting the verdict — typed errors are"
+                " deterministic server verdicts, NEVER transport-retried"
+                " (OPERATIONS.md). Raise it through, or branch on the"
+                " verdict (parse_primary / message check) before any"
+                " re-issue",
+            )
+        )
+
+    visit(mod.tree.body, ())
+
+
+def _scan_budgets(project, findings):
+    # bindings: (relpath, cls|None, attr-or-name) -> decl line
+    budgets: dict[tuple, int] = {}
+    spends: dict[tuple, tuple] = {}  # binding -> (relpath, line, qual)
+    refilled_attrs: set[str] = set()
+    for m in project.modules:
+        for cls_name, cls in sorted(m.symbols.classes.items()):
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                if m.symbols.canonical_of(node.value.func) != _BUDGET_CTOR:
+                    continue
+                for t in node.targets:
+                    d = dotted(t)
+                    if d and d.startswith("self.") and d.count(".") == 1:
+                        budgets[
+                            (m.relpath, cls_name, d[len("self."):])
+                        ] = node.lineno
+        for name, ctor in sorted(m.symbols.global_ctors.items()):
+            if ctor == _BUDGET_CTOR:
+                budgets[(m.relpath, None, name)] = 0
+    if not budgets:
+        return
+    names = {key[2] for key in budgets}
+    for m in project.modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            meth = node.func.attr
+            if meth not in ("try_spend", "on_success"):
+                continue
+            base = dotted(node.func.value)
+            if base is None:
+                continue
+            attr = base.rpartition(".")[2]
+            if attr not in names:
+                continue
+            if meth == "on_success":
+                refilled_attrs.add(attr)
+            else:
+                qual = m.qualname_of(node)
+                for key in sorted(budgets):
+                    if key[2] == attr and key not in spends:
+                        spends[key] = (m.relpath, node.lineno, qual)
+    for key in sorted(budgets):
+        attr = key[2]
+        if attr in refilled_attrs or key not in spends:
+            continue
+        relpath, line, qual = spends[key]
+        findings.append(
+            Finding(
+                "retry-budget-drain-only",
+                CHECKER,
+                relpath,
+                line,
+                qual,
+                f"RetryBudget `{attr}` is only ever drained"
+                " (try_spend with no on_success anywhere in the repo) —"
+                " one slow burst empties it and hedging/retry stays off"
+                " for the life of the process. Refill on un-hedged"
+                " success (the gRPC retry-throttle shape, retrieval"
+                " router lines 106/114)",
+            )
+        )
+
+
+@register
+class TypedErrorRetryChecker(Checker):
+    name = CHECKER
+
+    def check(self, project) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in project.modules:
+            _scan_handlers(mod, findings)
+        _scan_budgets(project, findings)
+        return findings

@@ -29,6 +29,7 @@ import ast
 import json
 import os
 import re
+import time
 from dataclasses import dataclass, field
 
 
@@ -137,9 +138,20 @@ class Project:
         self.modules = modules
         self.root = root
         self.by_relpath = {m.relpath: m for m in modules}
+        self._callgraph = None  # lazy (callgraph.ProjectCallGraph)
 
     def module(self, relpath: str) -> Module | None:
         return self.by_relpath.get(relpath)
+
+    @property
+    def callgraph(self):
+        """The repo-wide import-resolved call graph (built once per run;
+        every interprocedural checker shares it)."""
+        if self._callgraph is None:
+            from euler_tpu.analysis.callgraph import ProjectCallGraph
+
+            self._callgraph = ProjectCallGraph(self)
+        return self._callgraph
 
 
 # -- registry ---------------------------------------------------------------
@@ -254,6 +266,7 @@ class Report:
     baselined: list[Finding] = field(default_factory=list)
     stale_baseline: list[dict] = field(default_factory=list)
     files: int = 0
+    wall_s: float = 0.0  # full-run wall time (load + all checkers)
 
     @property
     def ok(self) -> bool:
@@ -270,6 +283,7 @@ class Report:
         return {
             "ok": self.ok,
             "files": self.files,
+            "wall_s": round(self.wall_s, 4),
             "counts": self.counts(),
             "total": len(self.findings),
             "suppressed": len(self.suppressed),
@@ -294,6 +308,7 @@ def run(
     checks: list[str] | None = None,
     baseline: list[dict] | None = None,
 ) -> Report:
+    t0 = time.monotonic()
     report = Report(files=len(project.modules))
     baseline = baseline or []
     matched_entries: set[int] = set()
@@ -323,4 +338,5 @@ def run(
     report.stale_baseline = [
         e for i, e in enumerate(baseline) if i not in matched_entries
     ]
+    report.wall_s = time.monotonic() - t0
     return report

@@ -339,16 +339,19 @@ class RemoteShard:
         completion_queue_pool.h): up to EULER_TPU_INFLIGHT (default 4)
         outstanding RPCs per shard, each worker thread on its own
         socket (thread-local in _Replica), retry/quarantine preserved."""
-        if self._pool is None:
+        pool = self._pool  # one read: a concurrent close() nulls the attr
+        if pool is None:
             with self._lock:
-                if self._pool is None:
+                pool = self._pool
+                if pool is None:
                     import os
 
                     depth = int(os.environ.get("EULER_TPU_INFLIGHT", "4"))
-                    self._pool = _DaemonExecutor(
+                    pool = _DaemonExecutor(
                         max(depth, 1), f"shard{self.shard}-rpc"
                     )
-        return self._pool
+                    self._pool = pool
+        return pool
 
     def submit(
         self,

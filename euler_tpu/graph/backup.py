@@ -822,8 +822,11 @@ def scrub_service(svc, repair: bool = True) -> dict:
     # and trims the log, which would silently discard — not detect —
     # any rot sitting in the soon-to-be-trimmed region) ------------------
     v = wal.verify()
-    report["wal_bytes_checked"] = int(v["end"] - wal.base)
-    report["bytes_scanned"] += int(v["end"] - wal.base)
+    # one read of the trim-swapped base: both byte counts must describe
+    # the same [base, end) window even if a concurrent publish trims
+    base = wal.base
+    report["wal_bytes_checked"] = int(v["end"] - base)
+    report["bytes_scanned"] += int(v["end"] - base)
     wal_repaired = v["ok"]
     if not v["ok"]:
         svc.scrub_corruptions += 1
@@ -935,11 +938,17 @@ def scrub_service(svc, repair: bool = True) -> dict:
             f"wal-at-rest-corruption at logical {int(v['valid_end'])}"
             " (no peer could repair); a restart would lose the suffix"
         )
-    elif not _has_restart_anchor(wal_dir, wal.base):
-        degraded = (
-            f"no usable snapshot covers WAL base {int(wal.base)}"
-            " (no peer could repair); a restart cannot recover"
-        )
+    else:
+        # deliberate re-sample, not a torn read: repairs above may have
+        # re-snapshotted + trimmed, and the verdict must describe the
+        # base the NEXT restart will actually see — but anchor check and
+        # message must agree on one value
+        wal_base = wal.base  # graftlint: disable=hot-swap-reread -- post-repair re-sample is the point
+        if not _has_restart_anchor(wal_dir, wal_base):
+            degraded = (
+                f"no usable snapshot covers WAL base {int(wal_base)}"
+                " (no peer could repair); a restart cannot recover"
+            )
     report["degraded"] = degraded
     svc.degraded = degraded
     svc.scrub_passes += 1

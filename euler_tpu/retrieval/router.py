@@ -85,7 +85,12 @@ class RetrievalRouter:
         )
 
     def _shard_retrieve(self, sh, values, deadline_s):
-        if self.hedge_ms is None or len(sh.replicas) < 2:
+        # ONE snapshot of the COW replica tuple: the hedge-or-not decision
+        # and the hedge-target pick below must see the same rotation (a
+        # sync_replicas swap between two reads could hedge against a set
+        # the primary pick never saw)
+        reps = sh.replicas
+        if self.hedge_ms is None or len(reps) < 2:
             return self._one(sh, values, deadline_s)
         # Primary + hedge go to the SHARD's own executor (leaf RPCs that
         # submit nothing further), never self._pool: the router pool runs
@@ -94,7 +99,6 @@ class RetrievalRouter:
         # fill every worker and wait on inner futures that can never be
         # scheduled. The shard pool only ever runs tasks that complete on
         # their own, so waiting on its futures always makes progress.
-        reps = sh.replicas  # one COW snapshot
         prim_rep = sh._pick()  # honors quarantine, advances the rotation
         prim_addr = (prim_rep.host, prim_rep.port)
         primary = sh.submit(

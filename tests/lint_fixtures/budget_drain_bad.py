@@ -1,0 +1,27 @@
+"""known-bad: a RetryBudget that is only ever drained.
+
+Distilled from the PR 17 hedge-budget review: `try_spend` gated every
+hedge but nothing ever paid tokens back, so one slow burst emptied the
+budget and hedging stayed off for the life of the process — the fleet
+silently degraded to plain fan-out forever instead of recovering.
+"""
+
+from euler_tpu.distributed.retry import RetryBudget
+
+
+class HedgedCaller:
+    def __init__(self, shard):
+        self._shard = shard
+        self._retry_tokens = RetryBudget(cap=8.0)
+
+    def retrieve(self, values):
+        primary = self._shard.submit("retrieve", values)
+        try:
+            return primary.result(timeout=0.05)
+        except TimeoutError:
+            pass
+        # BAD: spend with no on_success anywhere — drain-only budget
+        if not self._retry_tokens.try_spend():
+            return primary.result()
+        hedge = self._shard.submit("retrieve", values)
+        return hedge.result()

@@ -1,0 +1,27 @@
+"""fixed form: un-hedged successes refill the budget — the gRPC
+retry-throttle shape. Spend and refill are a pair: a systematically
+slow fleet degrades to plain fan-out AND recovers hedging once it
+answers in time again."""
+
+from euler_tpu.distributed.retry import RetryBudget
+
+
+class HedgedCallerFixed:
+    def __init__(self, shard):
+        self._shard = shard
+        self._hedge_budget = RetryBudget(cap=8.0)
+
+    def retrieve(self, values):
+        primary = self._shard.submit("retrieve", values)
+        try:
+            out = primary.result(timeout=0.05)
+            self._hedge_budget.on_success()  # un-hedged success refills
+            return out
+        except TimeoutError:
+            pass
+        if not self._hedge_budget.try_spend():
+            out = primary.result()
+            self._hedge_budget.on_success()  # slow but un-hedged: refill
+            return out
+        hedge = self._shard.submit("retrieve", values)
+        return hedge.result()

@@ -1,0 +1,35 @@
+"""known-bad: a bounded pool's workers submitting back into their own
+pool and blocking on the result.
+
+Distilled from the PR 17 retrieval-router review: `_fan_out` filled the
+router pool with `_shard_retrieve` tasks, and `_shard_retrieve` then
+submitted its primary/hedge attempts into the SAME pool and parked in
+`.result()` — once outer tasks occupied every worker, the inner tasks
+could never be scheduled. Nothing fails fast; the query path just stops,
+under load only.
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+
+
+class FanoutRouter:
+    def __init__(self, shards):
+        self.shards = list(shards)
+        self._pool = ThreadPoolExecutor(4)
+
+    def query(self, values):
+        # fine: the CALLER thread blocks on pool futures — it is not a
+        # pool worker, so the workers can always drain the queue
+        futs = [
+            self._pool.submit(self._shard_task, sh, values)
+            for sh in self.shards
+        ]
+        return [f.result() for f in futs]
+
+    def _shard_task(self, sh, values):
+        # BAD: runs on a _pool worker, submits back into _pool, waits
+        inner = self._pool.submit(self._leaf, sh, values)
+        return inner.result()
+
+    def _leaf(self, sh, values):
+        return sh.call("retrieve", values)

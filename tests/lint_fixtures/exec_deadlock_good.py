@@ -1,0 +1,27 @@
+"""fixed form (the shipped PR 17 fix): inner attempts go to a DIFFERENT
+executor whose tasks are leaves — nothing submitted into `_rpc` ever
+waits on `_rpc` futures, so waiting on them always makes progress."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+
+class FanoutRouterFixed:
+    def __init__(self, shards):
+        self.shards = list(shards)
+        self._pool = ThreadPoolExecutor(4)
+        # leaf RPCs only: no task in this pool blocks on this pool
+        self._rpc = ThreadPoolExecutor(8)
+
+    def query(self, values):
+        futs = [
+            self._pool.submit(self._shard_task, sh, values)
+            for sh in self.shards
+        ]
+        return [f.result() for f in futs]
+
+    def _shard_task(self, sh, values):
+        inner = self._rpc.submit(self._leaf, sh, values)
+        return inner.result()
+
+    def _leaf(self, sh, values):
+        return sh.call("retrieve", values)

@@ -1,0 +1,64 @@
+"""known-bad: swap-published references read more than once per request.
+
+Distilled from two PR 17 review findings: the post-swap canary that
+re-read `self._engine` — under a concurrent reload it validated and
+reported parity against whoever swapped LAST, not the engine it built —
+and the hedge path that re-read the shard's replica rotation mid-call,
+so the hedge-or-not decision and the hedge-target pick could see two
+different rotations.
+"""
+
+import threading
+
+
+def _build(path):
+    return object()
+
+
+class SwapServer:
+    HANDLED_VERBS = frozenset({"retrieve", "reload_corpus", "probe"})
+
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._engine = _build(path)
+
+    def dispatch(self, op, values, sh):
+        if op == "retrieve":
+            return self.search(values)
+        if op == "reload_corpus":
+            return self.reload(values[0])
+        return probe_shard(sh)
+
+    def search(self, values):
+        # BAD: two unlocked reads — a swap landing between them serves
+        # one request from two different engines
+        if self._engine is None:
+            raise RuntimeError("no corpus loaded")
+        return self._engine.topk(values)
+
+    def reload(self, path):
+        eng = _build(path)
+        with self._lock:
+            self._engine = eng
+        # BAD: the canary re-reads the published slot instead of probing
+        # the engine THIS call built — the PR 17 canary race
+        ids = self._engine.topk([0])
+        return (ids, self._engine.version)
+
+
+class ShardHandle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replicas = ()
+
+    def sync_replicas(self, new):
+        with self._lock:
+            self.replicas = tuple(new)
+
+
+def probe_shard(sh):
+    # BAD: the length check and the pick read the rotation twice — the
+    # pick can come from a rotation the check never saw
+    if len(sh.replicas) < 2:
+        return None
+    return sh.replicas[0]

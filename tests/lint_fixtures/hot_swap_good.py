@@ -1,0 +1,56 @@
+"""fixed forms: bind the swap-published reference ONCE at the top of the
+request and use the local everywhere — the canary probes the engine it
+built, the hedge path snapshots the rotation before deciding."""
+
+import threading
+
+
+def _build(path):
+    return object()
+
+
+class SwapServerFixed:
+    HANDLED_VERBS = frozenset({"retrieve", "reload_corpus", "probe"})
+
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._engine = _build(path)
+
+    def dispatch(self, op, values, sh):
+        if op == "retrieve":
+            return self.search(values)
+        if op == "reload_corpus":
+            return self.reload(values[0])
+        return probe_shard(sh)
+
+    def search(self, values):
+        eng = self._engine  # ONE read: this request's snapshot
+        if eng is None:
+            raise RuntimeError("no corpus loaded")
+        return eng.topk(values)
+
+    def reload(self, path):
+        eng = _build(path)
+        with self._lock:
+            self._engine = eng
+        # the canary probes the engine THIS call built — a concurrent
+        # swap cannot change what we report parity against
+        ids = eng.topk([0])
+        return (ids, eng.version)
+
+
+class ShardHandleFixed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.replicas = ()
+
+    def sync_replicas(self, new):
+        with self._lock:
+            self.replicas = tuple(new)
+
+
+def probe_shard(sh):
+    reps = sh.replicas  # ONE snapshot of the rotation
+    if len(reps) < 2:
+        return None
+    return reps[0]

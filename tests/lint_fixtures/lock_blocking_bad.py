@@ -1,0 +1,41 @@
+"""known-bad: blocking calls while holding a lock every worker needs.
+
+The anti-pattern behind several PR 16/17 review round-trips: status RPCs,
+pacing sleeps and future waits issued INSIDE the shared-state lock, so
+one slow peer (or one slow disk) stalls every thread that touches it.
+"""
+
+import threading
+import time
+
+
+class StatusPoller:
+    def __init__(self, conns):
+        self._lock = threading.Lock()
+        self._conns = dict(conns)
+        self._stats = {}
+        self._pending = []
+        self._stop = False
+
+    def start(self):
+        t = threading.Thread(target=self._poll_loop, daemon=True)
+        t.start()
+        return t
+
+    def _poll_loop(self):
+        while not self._stop:
+            with self._lock:
+                for name, conn in sorted(self._conns.items()):
+                    # BAD: wire RPC under the shared lock — one slow
+                    # peer stalls every reader of _stats
+                    self._stats[name] = conn.call("status", name)
+                # BAD: pacing sleep inside the lock
+                time.sleep(0.5)
+            self._drain()
+
+    def _drain(self):
+        with self._lock:
+            while self._pending:
+                fut = self._pending.pop()
+                # BAD: future wait under the lock
+                fut.result()

@@ -1,0 +1,37 @@
+"""known-bad: typed server verdicts transport-retried.
+
+Distilled from the PR 16 follower long-poll churn: an empty long-poll
+reply was decoded as if it carried a frame, the decode error surfaced as
+a typed `RpcError`, and the tail loop "fixed" it by tearing down the
+link and re-issuing the call — every idle poll, forever. Typed errors
+are deterministic verdicts: the same answer on any replica, any number
+of times. Blind re-issue turns a clean verdict into duplicated load.
+"""
+
+from euler_tpu.distributed.errors import DeadlineExceeded, RpcError
+
+
+class TailFollower:
+    def __init__(self, conn, dial):
+        self._conn = conn
+        self._dial = dial
+        self._pos = 0
+        self._stop = False
+
+    def tail_loop(self):
+        while not self._stop:
+            try:
+                reply = self._conn.call("wal_tail", self._pos)
+            except RpcError:
+                # BAD: verdict treated as a transport fault — re-dial
+                # and loop straight back into the same call
+                self._conn = self._dial()
+                continue
+            self._pos += len(reply)
+
+    def fetch(self, values):
+        try:
+            return self._conn.call("retrieve", values)
+        except DeadlineExceeded:
+            # BAD: blind second issue of the exact same call
+            return self._conn.call("retrieve", values)

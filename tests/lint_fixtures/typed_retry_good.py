@@ -1,0 +1,50 @@
+"""fixed forms: every typed catch either consults the verdict or keeps a
+raise path, and transport faults stay a separate (retryable) arm.
+
+The tail loop is the shipped PR 16 fix shape: an EMPTY long-poll reply
+is idle, not an error — short-circuit it before decoding instead of
+letting a decode failure masquerade as a server verdict.
+"""
+
+from euler_tpu.distributed.errors import NotPrimaryError, RpcError
+
+
+def parse_primary(e):
+    return str(e).rpartition(" ")[2]
+
+
+class TailFollowerFixed:
+    def __init__(self, conn, dial):
+        self._conn = conn
+        self._dial = dial
+        self._pos = 0
+        self._stop = False
+
+    def tail_loop(self):
+        while not self._stop:
+            try:
+                reply = self._conn.call("wal_tail", self._pos)
+            except RpcError as e:
+                if "wal trimmed" in str(e):  # consult the verdict
+                    self._pos = 0
+                    continue
+                raise  # any other verdict is fatal
+            if not reply:
+                continue  # empty long-poll: idle, NOT an error
+            self._pos += len(reply)
+
+    def write(self, rec):
+        try:
+            return self._conn.call("append", rec)
+        except NotPrimaryError as e:
+            # the verdict NAMES the new primary — re-route, don't retry
+            self._conn = self._dial(parse_primary(e))
+            return self._conn.call("append", rec)
+
+    def fetch(self, values):
+        try:
+            return self._conn.call("retrieve", values)
+        except (RpcError, OSError):
+            # mixed arm: transport faults are the retryable class; the
+            # checker leaves mixed-policy arms alone
+            return self._conn.call("retrieve", values)

@@ -299,3 +299,39 @@ def test_bench_smoke_remote_lane_cache_fields():
     assert row["paged_device_edges_per_sec"] > 0
     assert row["residual_fetch_hit_rate"] > 0, row
     assert row["residual_rows_refetched"] > 0
+
+
+def test_lint_json_lane_per_checker_counts():
+    """The lint lane's JSON line (graftlint v2): every registered checker
+    must publish a count key — a checker silently dropping out of the
+    counts dict means the lane stopped measuring it — and the full-run
+    wall time rides along so regressions in analysis cost are visible."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "euler_tpu.tools.lint", "--json"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["ok"] is True, row
+    expected = {
+        "blocking-under-lock",
+        "borrowed-buffer-escape",
+        "determinism",
+        "durable-write",
+        "executor-deadlock",
+        "hot-swap-reread",
+        "jit-purity",
+        "lock-discipline",
+        "typed-error-retry",
+        "unbounded-cache",
+        "wire-protocol",
+    }
+    assert set(row["counts"]) == expected, row["counts"]
+    assert all(v == 0 for v in row["counts"].values()), row["counts"]
+    assert row["files"] > 100, row
+    assert isinstance(row["wall_s"], float) and row["wall_s"] > 0, row

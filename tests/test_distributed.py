@@ -1068,3 +1068,37 @@ def test_native_engine_behind_service(tmp_path, fixture_graph_dict):
     finally:
         for s in services:
             s.stop()
+
+
+def test_remote_shard_executor_survives_concurrent_close():
+    """Pins the _executor fix: the built pool is returned through a
+    LOCAL, so a close() that nulls self._pool between the attribute read
+    and the return cannot make _executor hand back None."""
+    from euler_tpu.distributed.client import RemoteShard, _DaemonExecutor
+
+    class _RacyPoolShard(RemoteShard):
+        # _pool as a property: once the pool is built, only the FIRST
+        # read returns it — every later read observes a concurrent
+        # close() having already nulled the slot
+        @property
+        def _pool(self):
+            val = self.__dict__.get("_pool_val")
+            if val is not None:
+                if self.__dict__.get("_pool_reads", 0) >= 1:
+                    return None
+                self.__dict__["_pool_reads"] = 1
+            return val
+
+        @_pool.setter
+        def _pool(self, v):
+            self.__dict__["_pool_val"] = v
+            self.__dict__["_pool_reads"] = 0
+
+    sh = _RacyPoolShard(0, [("127.0.0.1", 1)])  # offline: never dials
+    try:
+        first = sh._executor()  # cold: builds, returns the local
+        assert isinstance(first, _DaemonExecutor)
+        second = sh._executor()  # warm read racing the simulated close
+        assert second is first  # the ONE read taken is the answer
+    finally:
+        sh.__dict__["_pool_val"].close()

@@ -411,8 +411,259 @@ def test_wal_lockstep_replication_verbs_exempt():
     assert "repl_status" in drift[0].message
 
 
+def test_executor_deadlock_fixture_trips():
+    findings = _check(
+        _fixture_project("exec_deadlock_bad.py"), "executor-deadlock"
+    )
+    ids = _ids(findings)
+    assert ids["executor-self-submit"] == 1, findings
+    f = findings[0]
+    # the PR 17 shape: the pool WORKER flags, the caller-thread fan-out
+    # in query() does not
+    assert f.symbol == "FanoutRouter._shard_task", findings
+    assert "_pool" in f.message
+
+
+def test_executor_deadlock_fixed_form_clean():
+    # the shipped fix shape: inner attempts go to a different, leaf-only
+    # executor — same blocking .result(), no self-submission
+    assert (
+        _check(_fixture_project("exec_deadlock_good.py"), "executor-deadlock")
+        == []
+    )
+
+
+def test_blocking_under_lock_fixture_trips():
+    findings = _check(
+        _fixture_project("lock_blocking_bad.py"), "blocking-under-lock"
+    )
+    ids = _ids(findings)
+    assert ids["lock-blocking-call"] == 3, findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "time.sleep" in msgs
+    assert "wire RPC" in msgs
+    assert "future wait" in msgs
+
+
+def test_blocking_under_lock_fixed_form_clean():
+    # fetch-outside-lock, plus the two sanctioned exemptions the good
+    # file exercises: Condition.wait on the held condition and os.fsync
+    # under a *sync*-named lock
+    assert (
+        _check(
+            _fixture_project("lock_blocking_good.py"), "blocking-under-lock"
+        )
+        == []
+    )
+
+
+def test_hot_swap_reread_fixture_trips():
+    findings = _check(_fixture_project("hot_swap_bad.py"), "hot-swap-reread")
+    ids = _ids(findings)
+    assert ids["hot-swap-reread"] == 3, findings
+    # the three PR 17 shapes: double read on the request path, the
+    # post-swap canary re-read, and the replica-rotation re-read through
+    # a local shard handle
+    assert {f.symbol for f in findings} == {
+        "SwapServer.search",
+        "SwapServer.reload",
+        "probe_shard",
+    }, findings
+
+
+def test_hot_swap_reread_fixed_form_clean():
+    assert (
+        _check(_fixture_project("hot_swap_good.py"), "hot-swap-reread") == []
+    )
+
+
+def test_typed_error_retry_fixture_trips():
+    findings = _check(
+        _fixture_project("typed_retry_bad.py"), "typed-error-retry"
+    )
+    ids = _ids(findings)
+    assert ids["typed-error-retry"] == 2, findings
+    by_symbol = {f.symbol: f.message for f in findings}
+    # both re-issue shapes: `continue` back into the calling loop (the
+    # PR 16 long-poll churn) and a direct second call in the handler
+    assert "continue" in by_symbol["TailFollower.tail_loop"]
+    assert "re-issues" in by_symbol["TailFollower.fetch"]
+
+
+def test_typed_error_retry_fixed_form_clean():
+    # consult-the-verdict, raise-path, and mixed-transport arms are all
+    # exempt — the sanctioned idioms from writer.py / client.py / the
+    # retrieval router
+    assert (
+        _check(_fixture_project("typed_retry_good.py"), "typed-error-retry")
+        == []
+    )
+
+
+def test_retry_budget_drain_fixture_trips():
+    findings = _check(
+        _fixture_project("budget_drain_bad.py"), "typed-error-retry"
+    )
+    ids = _ids(findings)
+    assert ids["retry-budget-drain-only"] == 1, findings
+    assert "_retry_tokens" in findings[0].message
+
+
+def test_retry_budget_drain_fixed_form_clean():
+    assert (
+        _check(_fixture_project("budget_drain_good.py"), "typed-error-retry")
+        == []
+    )
+
+
 # ---------------------------------------------------------------------------
-# 3. mechanism proofs
+# 3. the repo-wide call graph
+# ---------------------------------------------------------------------------
+
+
+def _two_module_project(worker_src, main_src):
+    return Project(
+        [
+            Module(
+                "euler_tpu/jobs/worker.py",
+                "euler_tpu/jobs/worker.py",
+                worker_src,
+            ),
+            Module(
+                "euler_tpu/jobs/main.py", "euler_tpu/jobs/main.py", main_src
+            ),
+        ],
+        root=".",
+    )
+
+
+def test_callgraph_cross_module_alias_edge():
+    """`from euler_tpu.jobs.worker import leaf as run_leaf; run_leaf()`
+    resolves to the worker module's function through the alias table."""
+    project = _two_module_project(
+        "def leaf():\n    return 1\n",
+        "from euler_tpu.jobs.worker import leaf as run_leaf\n"
+        "def caller():\n"
+        "    return run_leaf()\n",
+    )
+    cg = project.callgraph
+    assert (
+        "euler_tpu/jobs/worker.py::leaf"
+        in cg.edges["euler_tpu/jobs/main.py::caller"]
+    )
+
+
+def test_callgraph_executor_entry_propagates_across_modules():
+    """A Thread target imported from another module makes that module's
+    function an entry, and reachability propagates to its callees."""
+    project = _two_module_project(
+        "def work(x):\n"
+        "    return helper(x)\n"
+        "def helper(x):\n"
+        "    return x\n",
+        "import threading\n"
+        "from euler_tpu.jobs.worker import work\n"
+        "def spawn():\n"
+        "    threading.Thread(target=work).start()\n",
+    )
+    cg = project.callgraph
+    assert "euler_tpu/jobs/worker.py::work" in cg.entries
+    assert "euler_tpu/jobs/worker.py::helper" in cg.thread_reachable
+    # the spawning function itself is NOT thread-reachable
+    assert "euler_tpu/jobs/main.py::spawn" not in cg.thread_reachable
+
+
+def test_callgraph_pool_worker_facts():
+    """Everything transitively submitted into a bounded pool is one of
+    its workers, and owning_executors inverts the map."""
+    project = _fixture_project("exec_deadlock_bad.py")
+    cg = project.callgraph
+    rel = "tests/lint_fixtures/exec_deadlock_bad.py"
+    token = f"{rel}::FanoutRouter._pool"
+    workers = cg.pool_workers(token)
+    assert f"{rel}::FanoutRouter._shard_task" in workers
+    assert f"{rel}::FanoutRouter._leaf" in workers
+    assert f"{rel}::FanoutRouter.query" not in workers
+    assert token in cg.owning_executors(f"{rel}::FanoutRouter._shard_task")
+
+
+def test_callgraph_locks_on_entry_intersection():
+    """The `_locked`-suffix calling contract is machine-derived: a
+    function whose EVERY call site holds the lock has it on entry; one
+    bare call site drops it to the empty set."""
+    src = (
+        "import threading\n"
+        "class Store:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def put(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._merge_locked(k, v)\n"
+        "    def drop(self, k):\n"
+        "        with self._lock:\n"
+        "            self._merge_locked(k, None)\n"
+        "    def _merge_locked(self, k, v):\n"
+        "        pass\n"
+    )
+    project = Project([Module("s.py", "s.py", src)], root=".")
+    assert project.callgraph.locks_on_entry(
+        "s.py::Store._merge_locked"
+    ) == frozenset({"Store.self._lock"})
+    bare = src + "    def oops(self, k):\n        self._merge_locked(k, 0)\n"
+    project2 = Project([Module("s.py", "s.py", bare)], root=".")
+    assert project2.callgraph.locks_on_entry(
+        "s.py::Store._merge_locked"
+    ) == frozenset()
+
+
+def test_module_callgraph_class_method_reference_edges():
+    """An explicitly spelled `Class.method` reference is an edge in the
+    module-local graph (the `_refs_in` branch both lookups share)."""
+    from euler_tpu.analysis.callgraph import CallGraph
+
+    mod = _module_from(
+        "class C:\n"
+        "    def target(self):\n"
+        "        pass\n"
+        "def spawn():\n"
+        "    return C.target\n"
+    )
+    cgm = CallGraph(mod.tree, mod.symbols)
+    assert "C.target" in cgm.edges["spawn"]
+    assert cgm.edges["C.target"] == set()
+
+
+def test_findings_byte_identical_across_processes():
+    """Determinism pin: two fresh processes with DIFFERENT hash seeds
+    must emit byte-identical findings in identical order."""
+    fixtures = [
+        os.path.join(FIXTURES, n)
+        for n in (
+            "exec_deadlock_bad.py",
+            "hot_swap_bad.py",
+            "lock_blocking_bad.py",
+            "typed_retry_bad.py",
+            "budget_drain_bad.py",
+        )
+    ]
+    cmd = [
+        sys.executable, "-m", "euler_tpu.tools.lint", "--json",
+        "--no-baseline", *fixtures,
+    ]
+    outs = []
+    for seed in ("0", "1"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONHASHSEED=seed)
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env)
+        assert r.returncode == 1, r.stdout + r.stderr
+        payload = json.loads(r.stdout.strip().splitlines()[-1])
+        payload.pop("wall_s")
+        outs.append(json.dumps(payload, sort_keys=True))
+    assert outs[0] == outs[1]
+    assert json.loads(outs[0])["total"] >= 10
+
+
+# ---------------------------------------------------------------------------
+# 4. mechanism proofs
 # ---------------------------------------------------------------------------
 
 
@@ -525,6 +776,61 @@ def test_cli_exit_codes_and_json_lane():
     )
     assert good.returncode == 0, good.stdout + good.stderr
     assert json.loads(good.stdout.strip().splitlines()[-1])["ok"] is True
+
+
+def test_changed_only_scopes_findings_to_changed_files():
+    """--changed-only on a dirty tree: a freshly created (untracked) bad
+    file still trips; a tracked-and-unchanged bad fixture is filtered out
+    — and the exit code follows the SCOPED findings, not the full set."""
+    from euler_tpu.analysis.core import repo_root
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    probe = os.path.join(repo_root(), "euler_tpu", "_lint_changed_probe.py")
+    fixture_bad = os.path.join(FIXTURES, "det_bad.py")
+    base = [
+        sys.executable, "-m", "euler_tpu.tools.lint", "--json",
+        "--no-baseline",
+    ]
+    try:
+        with open(probe, "w", encoding="utf-8") as f:
+            f.write(
+                "import numpy as np\n"
+                "\n"
+                "def f(g):\n"
+                "    return g.sample(rng=np.random.default_rng())\n"
+            )
+        full = subprocess.run(
+            base + [probe, fixture_bad],
+            capture_output=True, text=True, env=env,
+        )
+        scoped = subprocess.run(
+            base + ["--changed-only", probe, fixture_bad],
+            capture_output=True, text=True, env=env,
+        )
+        assert full.returncode == 1, full.stdout + full.stderr
+        assert scoped.returncode == 1, scoped.stdout + scoped.stderr
+        full_paths = {
+            f["path"]
+            for f in json.loads(full.stdout.strip().splitlines()[-1])[
+                "findings"
+            ]
+        }
+        scoped_paths = {
+            f["path"]
+            for f in json.loads(scoped.stdout.strip().splitlines()[-1])[
+                "findings"
+            ]
+        }
+        assert "tests/lint_fixtures/det_bad.py" in full_paths
+        assert scoped_paths == {"euler_tpu/_lint_changed_probe.py"}
+        # only an unchanged file in scope -> scoped-clean, exit 0
+        clean = subprocess.run(
+            base + ["--changed-only", fixture_bad],
+            capture_output=True, text=True, env=env,
+        )
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+    finally:
+        os.remove(probe)
 
 
 def test_unknown_checker_name_rejected():

@@ -684,3 +684,52 @@ def test_e2e_recsys_conditioned_training_to_filtered_serving(tmp_path):
             s.stop()
         for s in services:
             s.stop()
+
+
+def test_hedge_decision_and_target_share_one_rotation_snapshot():
+    """Pins the _shard_retrieve fix: the COW replica tuple is read
+    exactly ONCE per call, so the hedge-or-not decision and the
+    hedge-target pick cannot observe two different rotations when a
+    sync_replicas swap lands mid-call."""
+    import concurrent.futures
+
+    from euler_tpu.retrieval.router import RetrievalRouter
+
+    class _Rep:
+        def __init__(self, host, port):
+            self.host, self.port = host, port
+
+    class _RotatingShard:
+        def __init__(self):
+            self._reps = (_Rep("a", 1), _Rep("b", 2))
+            self.replica_reads = 0
+            self.prefers = []
+
+        @property
+        def replicas(self):
+            # every read observes a DIFFERENT rotation — a racing
+            # sync_replicas swap between two reads
+            self.replica_reads += 1
+            self._reps = tuple(reversed(self._reps))
+            return self._reps
+
+        def _pick(self):
+            return self._reps[0]
+
+        def submit(self, verb, values, deadline_s=None, prefer=None):
+            self.prefers.append(prefer)
+            fut = concurrent.futures.Future()
+            if len(self.prefers) > 1:  # the hedge answers immediately
+                fut.set_result(("ids", "scores", "valid", "v1"))
+            return fut  # the primary never completes
+
+    router = RetrievalRouter([], hedge_ms=1.0)
+    sh = _RotatingShard()
+    try:
+        out = router._shard_retrieve(sh, ["q"], None)
+    finally:
+        router.close()
+    assert out == ("ids", "scores", "valid", "v1")
+    assert sh.replica_reads == 1  # ONE snapshot per call
+    # and the hedge was pinned to a replica the primary pick excluded
+    assert len(sh.prefers) == 2 and sh.prefers[0] != sh.prefers[1]
