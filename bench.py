@@ -1222,6 +1222,173 @@ def _retrieval_lane(smoke: bool) -> dict:
             srv.stop()
 
 
+def _reshard_lane(smoke: bool) -> dict:
+    """Elastic-reshard lane (ISSUE 19; EULER_BENCH_RESHARD=0 opt-out):
+    what a live 2 -> 3 shard split costs on the artifact — pure
+    repartition throughput (rows/s through `repartition_arrays`), the
+    coordinator's fence-to-commit cutover window, the writer-OBSERVED
+    write-unavailability gap (a client hammering single-row upserts
+    straight through the cutover, fence absorption + topology-watch
+    re-route included), and the `reshard_bit_parity` oracle — the
+    resharded cluster must hash identically to a from-scratch build of
+    exactly the acked mutations at the new shard count."""
+    import shutil
+    import tempfile
+    import threading
+
+    from euler_tpu.distributed import connect
+    from euler_tpu.distributed.registry import Registry
+    from euler_tpu.distributed.reshard import (
+        ReshardCoordinator, cluster_signature, repartition_arrays,
+    )
+    from euler_tpu.distributed.service import GraphService
+    from euler_tpu.distributed.writer import GraphWriter
+    from euler_tpu.graph import Graph
+    from euler_tpu.graph.builder import build_from_json
+
+    n = 300 if smoke else 3000
+    rng = np.random.default_rng(29)
+    nodes = [
+        {"id": i + 1, "type": 0, "weight": 1.0,
+         "features": [{"name": "feat", "type": "dense",
+                       "value": rng.normal(size=8).tolist()}]}
+        for i in range(n)
+    ]
+    edges = [
+        {"src": s, "dst": (s + off) % n + 1, "type": 0,
+         "weight": float(1 + (s + off) % 3), "features": []}
+        for s in range(1, n + 1)
+        for off in (1, 5)
+    ]
+    # canonical edge order: bit parity with a from-scratch build is
+    # defined over the canonically-ordered equivalent graph.json
+    edges.sort(key=lambda e: (e["src"], e["dst"], e["type"]))
+    data = {"nodes": nodes, "edges": edges}
+
+    # pure repartition throughput, no wire involved
+    meta_b, parts_b = build_from_json(data, 2)
+    t0 = time.perf_counter()
+    repartition_arrays(meta_b, parts_b, 3)
+    repart_s = time.perf_counter() - t0
+    rows_per_sec = (len(nodes) + len(edges)) / max(repart_s, 1e-9)
+
+    tmp = tempfile.mkdtemp(prefix="etpu_bench_reshard_")
+    reg = os.path.join(tmp, "reg")
+    old_refresh = os.environ.get("EULER_TPU_TOPOLOGY_REFRESH_S")
+    os.environ["EULER_TPU_TOPOLOGY_REFRESH_S"] = "0.2"
+    svcs, g, writer, co = [], None, None, None
+    try:
+        src = Graph.from_json(data, num_partitions=2)
+        for s in range(2):
+            svcs.append(
+                GraphService(
+                    src.shards[s], src.meta, s,
+                    registry=Registry(reg, ttl=10.0),
+                    wal_dir=os.path.join(tmp, f"wal_{s}"),
+                ).start()
+            )
+        g = connect(registry_path=reg, num_shards=2)
+        writer = GraphWriter(g)
+
+        # acked-write timeline straight through the cutover: the max
+        # inter-ack gap IS the client-observed unavailability window
+        acked: dict = {}
+        stop = threading.Event()
+        fail: list = []
+
+        def hammer():
+            try:
+                i = 0
+                stamps = [time.perf_counter()]
+                while not stop.is_set():
+                    s = int(rng.integers(1, n + 1))
+                    d = int(rng.integers(1, n + 1))
+                    w = float(i % 7 + 1)
+                    writer.upsert_edges([s], [d], [0], [w])
+                    writer.flush()
+                    acked[(s, d, 0)] = w
+                    stamps.append(time.perf_counter())
+                    i += 1
+                acked["_stamps"] = stamps
+            except Exception as e:  # noqa: BLE001
+                fail.append(repr(e))
+
+        th = threading.Thread(target=hammer, daemon=True)
+        th.start()
+        co = ReshardCoordinator(reg, 2, 3, os.path.join(tmp, "rs"))
+        report = co.run()
+        stop.set()
+        th.join(timeout=60)
+        if fail or report.get("outcome") != "done":
+            raise RuntimeError(f"reshard failed: {fail or report}")
+        stamps = acked.pop("_stamps")
+        gaps = np.diff(np.asarray(stamps))
+        unavail_ms = float(gaps.max()) * 1e3 if len(gaps) else 0.0
+        writer.publish()
+        writer.close()
+
+        # oracle: base + the acked upserts, from scratch at 3 shards
+        by_key = {(e["src"], e["dst"], e["type"]): e for e in data["edges"]}
+        for (s, d, t), w in acked.items():
+            if (s, d, t) in by_key:
+                by_key[(s, d, t)]["weight"] = w
+            else:
+                data["edges"].append(
+                    {"src": s, "dst": d, "type": t, "weight": w,
+                     "features": []}
+                )
+                by_key[(s, d, t)] = data["edges"][-1]
+        for proc in co._dest_procs:
+            proc.kill()
+            proc.wait(timeout=10)
+        gen1 = os.path.join(tmp, "rs", "gen_1")
+        from euler_tpu.graph import format as tformat
+        from euler_tpu.graph import wal as _wal
+        from euler_tpu.graph.meta import GraphMeta as _Meta
+        from euler_tpu.graph.store import GraphStore as _Store
+
+        meta_r = _Meta.load(os.path.join(gen1, "data"))
+        parts_r = []
+        for p in range(3):
+            arrays = tformat.read_arrays(
+                os.path.join(gen1, "data", f"part_{p}"), mmap=False
+            )
+            rec = _wal.recover(
+                meta_r, p, os.path.join(gen1, f"wal_{p}"),
+                _Store(meta_r, arrays, p),
+            )
+            parts_r.append(rec.store.arrays)
+        parity = cluster_signature(meta_r, parts_r) == cluster_signature(
+            *build_from_json(data, 3)
+        )
+        return {
+            "reshard": True,
+            "reshard_bit_parity": bool(parity),
+            "reshard_rows_per_sec": round(rows_per_sec, 1),
+            "reshard_cutover_ms": round(float(report["cutover_ms"]), 1),
+            "reshard_unavail_ms": round(unavail_ms, 1),
+        }
+    finally:
+        if old_refresh is None:
+            os.environ.pop("EULER_TPU_TOPOLOGY_REFRESH_S", None)
+        else:
+            os.environ["EULER_TPU_TOPOLOGY_REFRESH_S"] = old_refresh
+        if g is not None:
+            g.stop_topology_watch()
+        if co is not None:
+            for proc in co._dest_procs:
+                try:
+                    proc.kill()
+                except (OSError, ProcessLookupError):
+                    pass
+        for svc in svcs:
+            try:
+                svc.stop()
+            except OSError:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _resume_lane(smoke: bool) -> dict:
     """Durable-training lane (ISSUE 10; EULER_BENCH_RESUME=0 opt-out):
     checkpoint cost on the step path with the async writer vs inline
@@ -1804,6 +1971,18 @@ def run(platform: str) -> tuple[float, dict]:
             traceback.print_exc()
             extra.update(
                 {"retrieval": False, "retrieval_error": repr(e)[:300]}
+            )
+    # elastic-reshard lane (ISSUE 19) — repartition rows/s, cutover
+    # window, writer-observed unavailability, bit-parity oracle
+    if os.environ.get("EULER_BENCH_RESHARD", "1") != "0":
+        try:
+            extra.update(_reshard_lane(SMOKE))
+        except Exception as e:  # the lane must never void the headline
+            import traceback
+
+            traceback.print_exc()
+            extra.update(
+                {"reshard": False, "reshard_error": repr(e)[:300]}
             )
     probe = _probe_meta()
     if probe:

@@ -84,6 +84,10 @@ DEFAULT_DOMAINS = (
             # disaster recovery (ISSUE 15): the scrubber repairs from
             # peers over wal_ship and the CLI triggers scrub passes
             "euler_tpu/graph/backup.py",
+            # elastic resharding (ISSUE 19): the coordinator fences
+            # sources, drains their WAL tails and probes destinations
+            # over the same protocol
+            "euler_tpu/distributed/reshard.py",
         ),
         servers=("euler_tpu/distributed/service.py",),
     ),

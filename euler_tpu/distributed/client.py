@@ -332,6 +332,12 @@ class RemoteShard:
         # EULER_TPU_READ_CACHE_EPOCH_S seconds when set)
         self._epoch_checked = False
         self._epoch_next = 0.0
+        # topology_epoch handshake (PR 19 resharding): versions the shard
+        # LAYOUT. Row-keyed cache blocks (ids_by_rows, dense-by-rows)
+        # encode this shard's row space — after a reshard the same row
+        # index names a DIFFERENT node, so a change here forces a full
+        # cache flush (a graph_epoch bump alone cannot express that).
+        self._topo_epoch = 0
 
     def _executor(self) -> _DaemonExecutor:
         """Bounded executor for overlapped requests — the async
@@ -423,6 +429,14 @@ class RemoteShard:
                 or _Replica(a[0], a[1], self.shard, self._counters)
                 for a in want
             )
+            if set(want) != set(have):
+                # actual membership change: this handle may now front a
+                # DIFFERENT server (reshard cutover re-pointed the shard
+                # index at a new member) — re-run the stats handshake
+                # before the next cached read so a topology_epoch bump
+                # flushes row-keyed blocks instead of serving them
+                # against the wrong row space
+                self._epoch_checked = False
 
     def _pick(self, prefer: tuple[str, int] | None = None) -> _Replica:
         with self._lock:
@@ -561,6 +575,7 @@ class RemoteShard:
         lane and capacity dashboards poll), with this handle's read-cache
         telemetry attached under "read_cache"."""
         out = json.loads(self.call("stats", [])[0])
+        self._observe_topology(out)
         if self._cache is not None:
             # a stats poll doubles as an epoch observation: a bumped
             # graph_epoch invalidates the cache right here
@@ -599,15 +614,30 @@ class RemoteShard:
             self._cache.observe_epoch(epoch)
         return epoch
 
+    def _observe_topology(self, st: dict) -> None:
+        """React to the server's topology_epoch (PR 19): a change means
+        the shard LAYOUT moved — every row index may now name a
+        different node — so row-keyed cache blocks are not merely stale,
+        they are wrongly row-mapped. Full flush, and the cached
+        num_nodes must be re-learned from the new server."""
+        te = int(st.get("topology_epoch", 0))
+        if te == self._topo_epoch:
+            return
+        with self._lock:
+            self._topo_epoch = te
+            self._num_nodes = None
+        if self._cache is not None:
+            self._cache.clear()
+
     def _fetch_epoch(self) -> int:
         try:
-            return int(
-                json.loads(self.call("stats", [])[0]).get("graph_epoch", 0)
-            )
+            st = json.loads(self.call("stats", [])[0])
         except RpcError as e:
             if "unknown op" in str(e):
                 return 0  # pre-`stats` server: immutable era, cache-forever
             raise
+        self._observe_topology(st)
+        return int(st.get("graph_epoch", 0))
 
     def _cached(self) -> "ReadCache | None":
         """The read cache, after epoch maintenance: the first cached read
@@ -1357,15 +1387,48 @@ def connect(
         period = float(
             os.environ.get("EULER_TPU_TOPOLOGY_REFRESH_S", "2.0")
         )
-        n = len(shards)
+        topo0 = registry.topology() if hasattr(registry, "topology") else None
+        state = {
+            "shards": shards,
+            "gen": int(topo0.get("gen", 0)) if topo0 else 0,
+        }
 
         def _watch():
             while not stop.wait(period):
+                # elastic resharding (PR 19): a committed topology with a
+                # new (num_shards, gen) re-points EVERY handle the caller
+                # holds — fresh RemoteShards, fresh meta, one
+                # swap_topology — so trainers/writers/servers re-route
+                # without reconnecting. The registry's gen filter makes
+                # this atomic: the same lookup that reveals the new
+                # members hides the old ones.
                 try:
-                    table = registry.lookup(n)
-                except (OSError, RuntimeError):
+                    topo = (
+                        registry.topology()
+                        if hasattr(registry, "topology") else None
+                    )
+                    if topo and (
+                        int(topo.get("gen", 0)) != state["gen"]
+                        or int(topo["num_shards"]) != len(state["shards"])
+                    ):
+                        n2 = int(topo["num_shards"])
+                        table = registry.wait_for(n2, timeout=period * 2)
+                        new_shards = [
+                            RemoteShard(s, table[s]) for s in sorted(table)
+                        ]
+                        meta2 = GraphMeta.from_dict(
+                            json.loads(
+                                new_shards[0].call("get_meta", [])[0]
+                            )
+                        )
+                        g.swap_topology(meta2, new_shards)
+                        state["shards"] = new_shards
+                        state["gen"] = int(topo.get("gen", 0))
+                        continue
+                    table = registry.lookup(len(state["shards"]))
+                except (OSError, RuntimeError, TimeoutError):
                     continue  # registry briefly down: keep current set
-                for sh in shards:
+                for sh in state["shards"]:
                     sh.sync_replicas(table.get(sh.shard, []))
 
         threading.Thread(

@@ -70,6 +70,20 @@ class NotPrimaryError(RpcError):
         return None
 
 
+class ReshardFencedError(NotPrimaryError):
+    """A mutation landed on a source shard fenced for a reshard cutover.
+
+    Subclasses NotPrimaryError so writers that predate resharding treat
+    it with the redirect machinery they already have: the detail carries
+    `primary=?`, which makes them drop their primary pin, back off, and
+    re-discover — by which time `connect()`'s topology watch has re-routed
+    them to the new shard set. The fencing window is bounded by the
+    cutover (a few lease TTLs), so the bounded redirect loop rides it out.
+
+        "ReshardFencedError: shard=1 role=fenced term=7 primary=?"
+    """
+
+
 # pre-PR-4 serving name; same class, so except-clauses written against
 # either name keep working and the wire prefix stays one canonical string
 DeadlineExceededError = DeadlineExceeded
@@ -82,6 +96,7 @@ WIRE_ERRORS = {
     "DeadlineExceededError": DeadlineExceeded,
     "OverloadError": OverloadError,
     "NotPrimaryError": NotPrimaryError,
+    "ReshardFencedError": ReshardFencedError,
 }
 
 

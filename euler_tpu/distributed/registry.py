@@ -189,6 +189,56 @@ class Registry:
         except (OSError, json.JSONDecodeError):
             return None
 
+    # -- topology (PR 19 elastic resharding) ------------------------------
+    #
+    # One `topology.json` record per registry: {"num_shards", "gen",
+    # "epoch"}. `gen` is the membership generation — heartbeat entries
+    # carry their generation in meta["gen"] (absent = 0), and client-facing
+    # lookup() only returns entries of the CURRENT generation. A reshard
+    # boots destination shards at gen+1 (invisible to clients), then
+    # commits the whole topology flip with one set_topology() — the atomic
+    # cutover point: old-gen sources vanish from routing and new-gen
+    # destinations appear in the same read. No topology file means gen 0,
+    # so pre-reshard clusters (whose entries carry no gen) are unchanged.
+
+    def _topology_path(self) -> str:
+        return os.path.join(self.path, "topology.json")
+
+    def set_topology(self, num_shards: int, gen: int, epoch: int) -> dict:
+        """Atomically publish the cluster topology (fsync'd tmp + rename
+        — a torn cutover must never be observable)."""
+        rec = {
+            "num_shards": int(num_shards),
+            "gen": int(gen),
+            "epoch": int(epoch),
+        }
+        tmp = self._topology_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._topology_path())
+        return rec
+
+    def topology(self) -> dict | None:
+        """The committed topology record, or None (pre-reshard cluster)."""
+        try:
+            with open(self._topology_path()) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def _current_gen(self) -> int:
+        topo = self.topology()
+        return int(topo.get("gen", 0)) if topo else 0
+
+    @staticmethod
+    def _entry_gen(meta: dict | None) -> int:
+        try:
+            return int((meta or {}).get("gen", 0))
+        except (TypeError, ValueError):
+            return 0
+
     # -- client side -----------------------------------------------------
 
     def lookup_meta(
@@ -203,6 +253,8 @@ class Registry:
         }
         for name in sorted(os.listdir(self.path)):
             if not name.endswith(".json") or name.startswith("lease_"):
+                continue
+            if name == "topology.json":
                 continue
             try:
                 with open(os.path.join(self.path, name)) as f:
@@ -225,13 +277,19 @@ class Registry:
             return []
 
     def lookup(self, num_shards: int) -> dict[int, list[tuple[str, int]]]:
-        """shard → [(host, port), ...] with live heartbeats."""
+        """shard → [(host, port), ...] with live heartbeats, restricted
+        to the current topology generation (client routing view — a
+        mid-reshard destination at gen+1 stays invisible here until
+        set_topology commits the flip)."""
         now = time.time()
+        gen = self._current_gen()
         out: dict[int, list[tuple[str, int]]] = {
             s: [] for s in range(num_shards)
         }
         for name in sorted(os.listdir(self.path)):
             if not name.endswith(".json") or name.startswith("lease_"):
+                continue
+            if name == "topology.json":
                 continue
             try:
                 with open(os.path.join(self.path, name)) as f:
@@ -239,6 +297,8 @@ class Registry:
             except (OSError, json.JSONDecodeError):
                 continue
             if now - e.get("ts", 0) > self.ttl:
+                continue
+            if self._entry_gen(e.get("meta")) != gen:
                 continue
             s = int(e["shard"])
             if s in out:

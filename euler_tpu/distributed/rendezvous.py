@@ -43,6 +43,8 @@ class RendezvousServer:
                                            → (lease_json|"null",)
       lease_renew (group, holder, term, ttl) → (ok_bool,)
       lease_observe (group)                → (lease_json|"null",)
+      topo_set (num_shards, gen, epoch)    → ("ok",)   reshard cutover
+      topo_get ()                          → (topo_json|"null",)
 
     Leases are the replication fencing primitive (PR 13): one
     term-numbered TTL'd lease per replica group, holder = the primary's
@@ -59,6 +61,11 @@ class RendezvousServer:
         self._entries: dict[tuple[int, str, int], tuple[float, str]] = {}
         # group → {"term", "holder", "expires", "meta"}
         self._leases: dict[str, dict] = {}
+        # committed cluster topology (PR 19 resharding): {"num_shards",
+        # "gen", "epoch"} or None. Entries carry their generation in
+        # meta["gen"]; lookup filters to the committed gen, making
+        # topo_set the atomic cutover flip (registry.py parity).
+        self._topology: dict | None = None
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -138,7 +145,26 @@ class RendezvousServer:
                     [s, h, p, self._entries[(s, h, p)][1]]
                     for (s, h, p) in sorted(self._entries)
                 ]
-            return wire.encode("table", [json.dumps(table)])
+                topo = self._topology
+            # the committed gen rides the reply so TcpRegistry.lookup can
+            # filter client routing without a second round trip
+            gen = int(topo.get("gen", 0)) if topo else 0
+            return wire.encode("table", [json.dumps(table), gen])
+        if op == "topo_set":
+            rec = {
+                "num_shards": int(vals[0]),
+                "gen": int(vals[1]),
+                "epoch": int(vals[2]),
+            }
+            with self._lock:
+                self._topology = rec
+            return wire.encode("ok", [])
+        if op == "topo_get":
+            with self._lock:
+                topo = self._topology
+            return wire.encode(
+                "topo", ["null" if topo is None else json.dumps(topo)]
+            )
         if op == "lease_acquire":
             group, holder = str(vals[0]), str(vals[1])
             ttl, min_term = float(vals[2]), int(vals[3])
@@ -259,10 +285,19 @@ class TcpRegistry:
             s: [] for s in range(num_shards)
         }
         try:
-            (table_json,) = self._call("lookup", [])
+            vals = self._call("lookup", [])
         except OSError:
             return out
-        for s, h, p, *_meta in json.loads(table_json):
+        # reply is [table_json] pre-reshard, [table_json, gen] after: the
+        # gen filters client routing to the committed topology generation
+        gen = int(vals[1]) if len(vals) > 1 else 0
+        for s, h, p, *m in json.loads(vals[0]):
+            try:
+                entry_gen = int(json.loads(m[0]).get("gen", 0)) if m else 0
+            except (ValueError, AttributeError, json.JSONDecodeError):
+                entry_gen = 0
+            if entry_gen != gen:
+                continue
             if int(s) in out:
                 out[int(s)].append((str(h), int(p)))
         return out
@@ -271,10 +306,10 @@ class TcpRegistry:
         """Full live table including per-entry meta (the shared-dir
         Registry persists meta in its heartbeat files; this is the tcp://
         equivalent)."""
-        (table_json,) = self._call("lookup", [])
+        vals = self._call("lookup", [])
         return {
             (int(s), str(h), int(p)): json.loads(m[0]) if m else {}
-            for s, h, p, *m in json.loads(table_json)
+            for s, h, p, *m in json.loads(vals[0])
         }
 
     def members(self, shard: int) -> list[tuple[str, int, dict]]:
@@ -324,6 +359,30 @@ class TcpRegistry:
         (lease_json,) = self._call("lease_observe", [group])
         lease = json.loads(lease_json)
         return lease if lease else None
+
+    # -- topology (PR 19 elastic resharding) ------------------------------
+
+    def set_topology(self, num_shards: int, gen: int, epoch: int) -> dict:
+        """Atomically publish the cluster topology — the reshard cutover
+        commit point (registry.Registry.set_topology parity)."""
+        self._call(
+            "topo_set", [int(num_shards), int(gen), int(epoch)]
+        )
+        return {
+            "num_shards": int(num_shards),
+            "gen": int(gen),
+            "epoch": int(epoch),
+        }
+
+    def topology(self) -> dict | None:
+        """The committed topology record, or None (pre-reshard cluster
+        or a pre-reshard rendezvous server)."""
+        try:
+            (topo_json,) = self._call("topo_get", [])
+        except RuntimeError:
+            return None  # pre-reshard rendezvous: unknown op
+        topo = json.loads(topo_json)
+        return topo if topo else None
 
     def wait_for(self, num_shards: int, timeout: float = 30.0):
         deadline = time.time() + timeout

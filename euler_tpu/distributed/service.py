@@ -24,7 +24,11 @@ import time
 import numpy as np
 
 from euler_tpu.distributed import chaos, wire
-from euler_tpu.distributed.errors import RpcError
+from euler_tpu.distributed.errors import (
+    NotPrimaryError,
+    ReshardFencedError,
+    RpcError,
+)
 from euler_tpu.distributed.registry import Registry
 from euler_tpu.distributed.rendezvous import make_registry
 from euler_tpu.graph import format as tformat
@@ -389,10 +393,24 @@ class GraphService:
         replica: int | None = None,
         group_size: int = 1,
         lease_ttl: float | None = None,
+        generation: int = 0,
+        topology_epoch: int = 0,
     ):
         self.store = store
         self.meta = meta
         self.shard = shard
+        # elastic resharding (PR 19): `generation` is this member's
+        # membership generation — heartbeats carry it so registry lookups
+        # can hide mid-reshard destinations until the topology commit;
+        # `topology_epoch` rides `stats` so client read caches fully
+        # flush across a reshard (row-keyed blocks encode the OLD row
+        # space, a graph_epoch bump alone cannot express that). `_fenced`
+        # is the cutover write barrier: a non-None token refuses
+        # mutations/publishes with the typed ReshardFencedError.
+        self.generation = int(generation)
+        self.topology_epoch = int(topology_epoch)
+        self._fenced: str | None = None
+        self._fence_term = 0
         # streaming-mutation state (graph/delta.py): staged writes are
         # invisible to readers until publish_epoch merges them and swaps
         # self.store in ONE reference assignment (dispatch binds
@@ -444,6 +462,16 @@ class GraphService:
             self._wal = rec.wal
             self.recovery_report = rec.report
             self.recovering = False
+            # a fence set by a reshard cutover survives kill -9: the
+            # marker re-arms it before the socket binds, so a respawned
+            # source can never accept a write the migration missed
+            try:
+                with open(os.path.join(wal_dir, self.FENCE_MARKER)) as f:
+                    m = json.load(f)
+                self._fenced = str(m.get("token", "resharded"))
+                self._fence_term = int(m.get("term", 0))
+            except (OSError, ValueError, json.JSONDecodeError):
+                pass
         # _PoolServer reads this before spawning coordinator threads
         self.may_coordinate = meta.num_partitions > 1
         self.server = _PoolServer((host, port), self, workers)
@@ -496,12 +524,22 @@ class GraphService:
             # a replicated shard heartbeats the coordinator's live meta
             # dict (replica id, role, shipped position, term) — what
             # peers read during promotion
+            hb = (
+                self._repl.heartbeat_meta
+                if self._repl is not None else None
+            )
+            if self.generation:
+                # non-zero generations ride the heartbeat so lookups can
+                # filter; gen-0 members keep pre-reshard heartbeat bytes.
+                # A replicated shard's live heartbeat dict is mutated in
+                # place (peers read it through the beat), a solo shard
+                # gets a fresh one.
+                if self._repl is not None:
+                    hb["gen"] = self.generation
+                else:
+                    hb = {"gen": self.generation}
             self._beat = self.registry.register(
-                self.shard, self.host, self.port,
-                meta=(
-                    self._repl.heartbeat_meta
-                    if self._repl is not None else None
-                ),
+                self.shard, self.host, self.port, meta=hb,
             )
         if self._repl is not None:
             self._repl.start()
@@ -583,6 +621,7 @@ class GraphService:
         "dense_feature_udf",
         "edges_by_rows",
         "exec_plan",
+        "fence",
         "frontier_exchange",
         "get_binary_feature",
         "get_dense_by_rows",
@@ -616,6 +655,7 @@ class GraphService:
         "sample_node_with_condition",
         "scrub",
         "stats",
+        "unfence",
         "unit_edge_weights",
         "upsert_edges",
         "upsert_nodes",
@@ -658,6 +698,13 @@ class GraphService:
                 # yet-snapshotted WAL, the epoch the newest snapshot
                 # covers (null = none yet / WAL off), and whether the
                 # shard is mid-recovery. Old clients ignore the fields.
+                # elastic resharding (PR 19): the topology epoch versions
+                # the SHARD LAYOUT the way graph_epoch versions the data.
+                # A change means row spaces moved — clients must fully
+                # flush row-keyed cache blocks, not just invalidate rows.
+                # Old clients ignore the field; old servers omit it.
+                "topology_epoch": int(self.topology_epoch),
+                "fenced": self._fenced is not None,
                 "wal_bytes": self._wal.size() if self._wal else 0,
                 "last_snapshot_epoch": self._last_snapshot_epoch,
                 "recovering": bool(self.recovering),
@@ -702,6 +749,10 @@ class GraphService:
             # snapshot state for bootstrap). The from_pos doubles as the
             # follower's durable-ack position (quorum accounting).
             return self._wal_ship(a)
+        if op == "fence":
+            return self._fence(a)
+        if op == "unfence":
+            return self._unfence(a)
         if op == "upsert_nodes":
             return self._stage_mutation(op, a)
         if op == "upsert_edges":
@@ -994,6 +1045,75 @@ class GraphService:
     # rows=None (full-invalidate) instead of caching huge arrays
     PUBLISH_RESULT_CAP = 65536
 
+    # -- reshard fencing (PR 19) -----------------------------------------
+
+    # durable fence marker (inside wal_dir): a fenced source that is
+    # kill -9'd and respawned boots fenced again — see _fence
+    FENCE_MARKER = "reshard_fence.json"
+
+    def _check_fenced(self) -> None:
+        """Refuse mutations/publishes while a reshard cutover holds the
+        fence. The typed error subclasses NotPrimaryError with
+        `primary=?`, so pre-reshard writers ride their existing
+        redirect/backoff loop while the topology watch re-routes them."""
+        if self._fenced is not None:
+            raise ReshardFencedError(
+                NotPrimaryError.format(
+                    self.shard, "fenced", self._fence_term, None
+                )
+            )
+
+    def _fence(self, a: list) -> list:
+        """Cutover write barrier: set the fence (new mutations refuse
+        from here on), then take the delta lock once — any mutation that
+        passed the gate before the flag landed has committed and
+        released by the time the lock is ours, so the returned WAL end
+        is stable until unfence. args [token, term]; replies
+        [term, wal_end, graph_epoch]. Idempotent per token.
+
+        The fence is DURABLE when the shard has a wal_dir: a marker file
+        survives kill -9 + supervised respawn, so a source that crashes
+        mid-cutover comes back still refusing writes — without it, a
+        restarted source would silently accept (and lose) writes that
+        the committed cutover already migrated past."""
+        token = str(a[0]) if a and a[0] is not None else "fenced"
+        term = int(a[1]) if len(a) > 1 and a[1] is not None else 0
+        self._fenced = token
+        self._fence_term = max(self._fence_term, term)
+        if self.wal_dir is not None:
+            marker = os.path.join(self.wal_dir, self.FENCE_MARKER)
+            tmp = marker + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"token": token, "term": int(self._fence_term)}, f
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, marker)
+        with self._delta_lock:
+            wal_end = int(self._wal.tell()) if self._wal is not None else 0
+            epoch = int(getattr(self.store, "graph_epoch", 0))
+        return [
+            int(self._repl.term) if self._repl is not None else 0,
+            wal_end,
+            epoch,
+        ]
+
+    def _unfence(self, a: list) -> list:
+        """Lift the fence (reshard abort / rollback). An empty token
+        lifts unconditionally; a token only lifts its own fence, so a
+        stale aborting coordinator cannot unfence a newer reshard.
+        Removes the durable marker. Replies [unfenced_bool]."""
+        token = str(a[0]) if a and a[0] is not None else ""
+        if self._fenced is not None and token in ("", self._fenced):
+            self._fenced = None
+            if self.wal_dir is not None:
+                try:
+                    os.remove(os.path.join(self.wal_dir, self.FENCE_MARKER))
+                except OSError:
+                    pass
+        return [self._fenced is None]
+
     def _stage_mutation(self, op: str, a: list) -> list:
         """Stage one writer batch into the shard's delta overlay.
 
@@ -1016,6 +1136,7 @@ class GraphService:
         # typed NotPrimaryError naming the current primary — the
         # writer's redirect signal. The gate sits BEFORE any state
         # changes, so a rejected write leaves nothing behind.
+        self._check_fenced()
         if self._repl is not None:
             self._repl.check_primary()
         key = str(a[0])
@@ -1073,6 +1194,7 @@ class GraphService:
         None row/id sets tell the client to fully flush its cache (used
         for oversized stale sets and for retried publishes whose first
         response was lost)."""
+        self._check_fenced()
         if self._repl is not None:
             self._repl.check_primary()
         seq = None
@@ -1252,6 +1374,11 @@ class GraphService:
             "wal_base": int(self._wal.base) if self._wal else 0,
             "wal_end": int(self._wal.tell()) if self._wal else 0,
             "graph_epoch": int(getattr(self.store, "graph_epoch", 0)),
+            # elastic resharding (PR 19): operators watch the fence and
+            # membership generation off the same dashboard row
+            "fenced": self._fenced is not None,
+            "generation": int(self.generation),
+            "topology_epoch": int(self.topology_epoch),
             # at-rest integrity (PR 15): ops dashboards read the
             # degraded flag and scrub counters off the same row
             "degraded": self.degraded,
@@ -1636,6 +1763,8 @@ def serve_shard(
     replica: int | None = None,
     group_size: int = 1,
     lease_ttl: float | None = None,
+    generation: int = 0,
+    topology_epoch: int = 0,
 ) -> GraphService:
     """Load shard `shard` of the dataset at data_dir and serve it.
 
@@ -1669,7 +1798,8 @@ def serve_shard(
     return GraphService(
         store, meta, shard, host, port, registry, workers=workers,
         wal_dir=wal_dir, replica=replica, group_size=group_size,
-        lease_ttl=lease_ttl,
+        lease_ttl=lease_ttl, generation=generation,
+        topology_epoch=topology_epoch,
     ).start()
 
 
@@ -1693,6 +1823,14 @@ def main(argv=None):
     ap.add_argument("--lease-ttl", type=float, default=None,
                     help="primary lease TTL seconds (default from"
                          " EULER_TPU_LEASE_TTL_S, else 5)")
+    ap.add_argument("--generation", type=int, default=0,
+                    help="membership generation carried in the registry"
+                         " heartbeat (reshard destinations boot at gen+1"
+                         " and stay invisible to clients until the"
+                         " topology commit)")
+    ap.add_argument("--topology-epoch", type=int, default=0,
+                    help="topology epoch surfaced via stats (client read"
+                         " caches fully flush when it changes)")
     args = ap.parse_args(argv)
     svc = serve_shard(
         args.data,
@@ -1705,6 +1843,8 @@ def main(argv=None):
         replica=args.replica,
         group_size=args.replicas,
         lease_ttl=args.lease_ttl,
+        generation=args.generation,
+        topology_epoch=args.topology_epoch,
     )
     if svc.recovery_report and svc.recovery_report.get("recovered"):
         print(

@@ -6,9 +6,13 @@ shard stayed dead forever. With the WAL + snapshot layer (graph/wal.py)
 a restart is cheap and LOSSLESS, so the supervisor closes the loop:
 
 - `start()` spawns one `python -m euler_tpu.distributed.service` process
-  per shard on FIXED ports (clients hold static replica lists — a
-  restart must come back on the address they already know) with a
-  per-shard `--wal-dir`.
+  per shard with a per-shard `--wal-dir`. Ports are FIXED by default
+  (clients holding static replica lists get the restart back on the
+  address they already know); `dynamic_ports=True` drops that
+  assumption — every (re)spawn binds a fresh OS-assigned port and
+  clients discover it through the registry heartbeat (connect()'s
+  watch), the same contract replica groups already use. `cluster()`
+  always reports the LIVE port map.
 - A monitor thread polls the children; an exited shard (crash, OOM-kill,
   `kill -9`) is respawned with exponential backoff, bounded by
   `max_restarts` within the backoff window (a healthy stretch of uptime
@@ -105,6 +109,7 @@ class ShardSupervisor:
         native: bool = False,
         env: dict | None = None,
         scrub_s: float | None = None,
+        dynamic_ports: bool = False,
     ):
         self.data_dir = data_dir
         self.num_shards = int(num_shards)
@@ -121,12 +126,23 @@ class ShardSupervisor:
         # at-rest integrity cadence for every child (EULER_TPU_SCRUB_S;
         # None inherits the supervisor's environment, 0 disables)
         self.scrub_s = scrub_s
+        # dynamic_ports drops the fixed-port assumption: every (re)spawn
+        # binds a fresh OS-assigned port and the registry heartbeat is
+        # how clients (and cluster()) learn the live address — required
+        # for elastic reshard flows where shard counts change and no
+        # static replica list can stay valid anyway
+        if dynamic_ports and ports is not None:
+            raise ValueError("dynamic_ports is incompatible with ports=")
+        self.dynamic_ports = bool(dynamic_ports)
         os.makedirs(wal_root, exist_ok=True)
-        ports = (
-            list(ports)
-            if ports is not None
-            else [_free_port(host) for _ in range(self.num_shards)]
-        )
+        if dynamic_ports:
+            ports = [0] * self.num_shards  # allocated per spawn
+        else:
+            ports = (
+                list(ports)
+                if ports is not None
+                else [_free_port(host) for _ in range(self.num_shards)]
+            )
         if len(ports) != self.num_shards:
             raise ValueError("need one port per shard")
         self.shards = [
@@ -142,6 +158,11 @@ class ShardSupervisor:
     def _spawn(self, sh: _Shard) -> None:
         # callers (start(), the monitor loop) hold self._lock across this
         os.makedirs(sh.wal_dir, exist_ok=True)
+        if self.dynamic_ports:
+            # fresh port every spawn — the registry heartbeat (not a
+            # static list) is the contract clients route by
+            # graftlint: disable=lock-unguarded-write -- every caller holds self._lock around _spawn
+            sh.port = _free_port(self.host)
         cmd = [
             sys.executable, "-m", "euler_tpu.distributed.service",
             "--data", self.data_dir,
@@ -272,9 +293,15 @@ class ShardSupervisor:
             }
 
     def cluster(self) -> dict[int, list[tuple[str, int]]]:
-        """Static cluster spec for `distributed.connect(cluster=...)` —
-        stable across restarts because ports are fixed."""
-        return {sh.shard: [(self.host, sh.port)] for sh in self.shards}
+        """LIVE cluster spec for `distributed.connect(cluster=...)`.
+        Fixed-port mode: stable across restarts. dynamic_ports mode: the
+        map as of NOW — a respawn moves ports, so long-lived clients
+        should connect through the registry instead and treat this as a
+        point-in-time snapshot (registry heartbeats confirm it)."""
+        with self._lock:
+            return {
+                sh.shard: [(self.host, sh.port)] for sh in self.shards
+            }
 
     def stop(self, term_timeout_s: float = 10.0) -> None:
         """Stop supervising, then the children: SIGTERM (the service
@@ -791,6 +818,9 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--ports", default=None,
                     help="comma-separated fixed ports (default: auto)")
+    ap.add_argument("--dynamic-ports", action="store_true",
+                    help="fresh OS-assigned port per (re)spawn; clients"
+                         " route via the registry heartbeat")
     ap.add_argument("--max-restarts", type=int, default=8)
     ap.add_argument("--native", action="store_true")
     ap.add_argument("--replication", type=int, default=1,
@@ -815,7 +845,7 @@ def main(argv=None) -> int:
         sup = ShardSupervisor(
             args.data, args.shards, args.registry, args.wal_root,
             host=args.host, ports=ports, max_restarts=args.max_restarts,
-            native=args.native,
+            native=args.native, dynamic_ports=args.dynamic_ports,
         ).start()
     healthy = sup.wait_healthy(timeout_s=120.0)
     print(json.dumps({"healthy": healthy, **sup.stats()}), flush=True)

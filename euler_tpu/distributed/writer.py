@@ -46,7 +46,11 @@ import time
 
 import numpy as np
 
-from euler_tpu.distributed.errors import NotPrimaryError, RpcError
+from euler_tpu.distributed.errors import (
+    NotPrimaryError,
+    ReshardFencedError,
+    RpcError,
+)
 from euler_tpu.graph.meta import GraphMeta
 
 
@@ -82,7 +86,6 @@ class GraphWriter:
 
     def __init__(self, graph, batch_rows: int = 4096, writer_id: str | None = None):
         self.graph = graph
-        self.num_shards = graph.num_shards
         self.batch_rows = max(int(batch_rows), 1)
         # unique per writer instance; uniqueness (not determinism) is
         # what idempotency keys need
@@ -98,7 +101,7 @@ class GraphWriter:
         # keyed outbox: batches that already own an idempotency key but
         # are not yet acked — a re-flush after a failure re-sends THESE
         # entries with their original keys
-        self._outbox: list = []  # (shard_idx, verb, values)
+        self._outbox: list = []  # (key, shard_idx, verb, values, scatter_P)
         self._local_deltas: dict = {}
         self._closed = False
         # replica groups: per-shard primary hint (host, port) — learned
@@ -150,6 +153,13 @@ class GraphWriter:
     def _ensure_open(self) -> None:
         if self._closed:
             raise ValueError("GraphWriter is closed")
+
+    @property
+    def num_shards(self) -> int:
+        """Live shard count — read through to the facade every time so a
+        reshard (swap_topology) is picked up by the next scatter instead
+        of being frozen at construction."""
+        return len(self.graph.shards)
 
     # -- buffering --------------------------------------------------------
 
@@ -292,7 +302,10 @@ class GraphWriter:
                 entries.append((int(s), "delete_nodes", [ids[owner == s]]))
         with self._lock:
             for e in entries:
-                self._outbox.append((self._key(),) + e)
+                # each entry remembers the shard count it was scattered
+                # under: flush() re-splits stale entries when the
+                # cluster resharded between staging and sending
+                self._outbox.append((self._key(),) + e + (P,))
 
     def _local_delta(self, part: int):
         from euler_tpu.graph.delta import DeltaStore
@@ -309,38 +322,150 @@ class GraphWriter:
         """Send every outbox entry to its owner shard. Raises on the
         first failure with the unsent entries retained — a later flush
         (or publish) re-sends them under their ORIGINAL keys, so the
-        whole call is retry-safe end to end."""
+        whole call is retry-safe end to end.
+
+        Reshard-aware: an entry scattered under P shards that is still
+        in the outbox when the cluster reshards to P' is re-split by
+        the CURRENT modulo (same idempotency key, so a half-sent batch
+        stays exactly-once), and a ReshardFencedError mid-cutover is
+        absorbed by waiting for the topology watch to re-route before
+        re-scattering the batch."""
         self._stage_outbox()
         with self._lock:
             outbox = list(self._outbox)
         sent = 0
         for entry in outbox:
-            key, shard_idx, verb, values = entry
-            sh = self.graph.shards[shard_idx]
-            if hasattr(sh, "call"):
-                if verb not in (
-                    "upsert_nodes", "upsert_edges", "delete_edges"
-                ):  # guarded in delete_nodes()
-                    raise ValueError("delete_nodes is not a wire verb")
-                reply = self._send_mutation(
-                    sh, shard_idx, verb, [key] + values
-                )
-                self.rows_sent += int(reply[0])
+            key, shard_idx, verb, values, scatter_p = entry
+            cur_p = self.num_shards
+            if cur_p != scatter_p:
+                # topology changed since staging: the old shard_idx is
+                # meaningless — re-split the rows by the new modulo
+                for dest, sub in self._resplit(verb, values, cur_p):
+                    self._send_split(key, dest, verb, sub)
             else:
-                d = self._local_delta(shard_idx)
-                if verb == "upsert_nodes":
-                    d.stage_nodes(*values)
-                elif verb == "upsert_edges":
-                    d.stage_edges(*values)
-                elif verb == "delete_edges":
-                    d.stage_edge_deletes(*values)
-                else:
-                    d.stage_node_deletes(*values)
+                self._send_split(key, shard_idx, verb, values)
             with self._lock:
                 self._outbox.remove(entry)
             self.batches_sent += 1
             sent += 1
         return sent
+
+    def _send_split(self, key: str, shard_idx: int, verb: str, values: list):
+        """Deliver one (possibly re-split) batch to one shard, absorbing
+        a fenced-cutover rejection by waiting for the new topology and
+        re-scattering under it (original key — exactly-once holds: the
+        reshard seeds dest applied-key windows from the sources)."""
+        sh = self.graph.shards[shard_idx]
+        if not hasattr(sh, "call"):
+            d = self._local_delta(shard_idx)
+            if verb == "upsert_nodes":
+                d.stage_nodes(*values)
+            elif verb == "upsert_edges":
+                d.stage_edges(*values)
+            elif verb == "delete_edges":
+                d.stage_edge_deletes(*values)
+            else:
+                d.stage_node_deletes(*values)
+            return
+        if verb not in (
+            "upsert_nodes", "upsert_edges", "delete_edges"
+        ):  # guarded in delete_nodes()
+            raise ValueError("delete_nodes is not a wire verb")
+        # capture BEFORE the send: a topology swap racing the fence
+        # rejection is then seen immediately instead of stalling the
+        # wait loop for its full budget
+        p0, te0 = self.num_shards, int(getattr(self.graph, "topology_epoch", 0))
+        try:
+            reply = self._send_mutation(sh, shard_idx, verb, [key] + values)
+        except ReshardFencedError:
+            # cutover in flight: the source refused the write so the
+            # migrated tail stays bounded. Wait (bounded) for connect()'s
+            # topology watch to swap the facade, then re-send by the new
+            # modulo. If the reshard ABORTED instead, the wait times out
+            # with the topology unchanged and the re-send goes back to
+            # the (now unfenced) original shards.
+            self._await_topology_change(p0, te0)
+            cur_p = self.num_shards
+            for dest, sub in self._resplit(verb, values, cur_p):
+                sh2 = self.graph.shards[dest]
+                r2 = self._send_mutation(sh2, dest, verb, [key] + sub)
+                self.rows_sent += int(r2[0])
+            return
+        self.rows_sent += int(reply[0])
+
+    def _await_topology_change(
+        self, p0: int | None = None, te0: int | None = None
+    ) -> bool:
+        """Poll the facade for a topology swap (shard count or
+        topology_epoch change) away from the captured (p0, te0) — pass
+        values captured BEFORE the failed send so a swap that raced the
+        rejection is seen at once — for up to
+        EULER_TPU_RESHARD_WRITER_WAIT_S seconds (default 10). Returns
+        True when a change was seen."""
+        budget = float(os.environ.get("EULER_TPU_RESHARD_WRITER_WAIT_S", "10"))
+        p0 = self.num_shards if p0 is None else int(p0)
+        te0 = (
+            int(getattr(self.graph, "topology_epoch", 0))
+            if te0 is None else int(te0)
+        )
+        deadline = time.monotonic() + max(budget, 0.0)
+        while time.monotonic() < deadline:
+            if (
+                self.num_shards != p0
+                or int(getattr(self.graph, "topology_epoch", 0)) != te0
+            ):
+                return True
+            time.sleep(0.05)
+        return False
+
+    @staticmethod
+    def _resplit(verb: str, values: list, P: int) -> list:
+        """Re-scatter one outbox entry's rows by `id % P` under a NEW
+        shard count, preserving the writer wire layouts (out-half
+        src-owned / in-half dst-owned for edge verbs)."""
+        out: list = []
+        if verb in ("upsert_nodes", "delete_nodes"):
+            ids = values[0]
+            owner = (ids % np.uint64(P)).astype(np.int64)
+            for s in np.unique(owner):
+                sel = owner == s
+                if verb == "upsert_nodes":
+                    _, types, weights, names, block = values
+                    out.append((int(s), [
+                        ids[sel], types[sel], weights[sel], list(names),
+                        block[sel] if block is not None else None,
+                    ]))
+                else:
+                    out.append((int(s), [ids[sel]]))
+            return out
+        if verb == "upsert_edges":
+            osrc, odst, ott, ow, isrc, idst, itt, iw = values
+            o_owner = (osrc % np.uint64(P)).astype(np.int64)
+            i_owner = (idst % np.uint64(P)).astype(np.int64)
+            for s in range(P):
+                osel = o_owner == s
+                isel = i_owner == s
+                if not (osel.any() or isel.any()):
+                    continue
+                out.append((s, [
+                    osrc[osel], odst[osel], ott[osel], ow[osel],
+                    isrc[isel], idst[isel], itt[isel], iw[isel],
+                ]))
+            return out
+        # delete_edges
+        osrc, odst, ott, isrc, idst, itt = values
+        o_owner = (osrc % np.uint64(P)).astype(np.int64)
+        i_owner = (idst % np.uint64(P)).astype(np.int64)
+        for s in range(P):
+            osel = o_owner == s
+            isel = i_owner == s
+            if not (osel.any() or isel.any()):
+                continue
+            out.append((s, [
+                osrc[osel], odst[osel], ott[osel],
+                isrc[isel], idst[isel], itt[isel],
+            ]))
+        return out
 
     # -- replica-group routing --------------------------------------------
 
@@ -438,9 +563,19 @@ class GraphWriter:
         exact = True
         for s, sh in enumerate(self.graph.shards):
             if hasattr(sh, "call"):
-                ep, rows, ids, n = self._send_mutation(
-                    sh, s, "publish_epoch", [self._key()]
-                )[:4]
+                p0 = self.num_shards
+                te0 = int(getattr(self.graph, "topology_epoch", 0))
+                try:
+                    ep, rows, ids, n = self._send_mutation(
+                        sh, s, "publish_epoch", [self._key()]
+                    )[:4]
+                except ReshardFencedError:
+                    # cutover fenced this source mid-publish: wait for
+                    # the topology swap, then publish the NEW shard set
+                    # from scratch (a republish of already-merged shards
+                    # is a no-op epoch-wise, so this is safe)
+                    self._await_topology_change(p0, te0)
+                    return self.publish()
                 sh.on_publish(ep, rows=rows, ids=ids, num_nodes=int(n))
             else:
                 delta = self._local_deltas.pop(s, None)

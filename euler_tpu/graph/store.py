@@ -1017,6 +1017,10 @@ class Graph:
         self.meta = meta
         self.shards = shards
         self.num_shards = len(shards)
+        # elastic resharding (PR 19): bumped by swap_topology so writers
+        # and device staging know the shard LAYOUT changed (row spaces
+        # moved), independently of per-shard graph_epoch data versions
+        self.topology_epoch = 0
         # shard-weighted root sampling (query_proxy.cc:91-144)
         self._node_shard_w = np.asarray(meta.node_weight_sums, dtype=np.float64)
         self._edge_shard_w = np.asarray(meta.edge_weight_sums, dtype=np.float64)
@@ -1054,6 +1058,53 @@ class Graph:
         self._edge_shard_w = np.asarray(
             self.meta.edge_weight_sums, dtype=np.float64
         )
+
+    def swap_topology(self, meta: GraphMeta, shards: list) -> int:
+        """Re-point this facade at a resharded cluster P→P′ in place
+        (PR 19): `connect()`'s topology watch calls this so every handle
+        the trainer/writer/server already holds re-routes without a
+        reconnect. Returns the bumped topology_epoch.
+
+        Lock-free against in-flight readers by assignment ordering:
+        `_scatter_gather` derives the shard count from ONE snapshot of
+        the shards list, and the root-sampling paths (which read the
+        weight tables and the shards list separately) are ordered so any
+        interleaving indexes in bounds — a grow publishes the longer
+        shards list first, a shrink publishes the shorter weight tables
+        first. A reader racing the swap instant may route one request to
+        a shard that no longer owns the id and get the standard
+        missing-row defaults; the next call is consistent. The old
+        dispatch pool is intentionally NOT shut down — an in-flight
+        scatter may still hold it, and reshards are rare enough that an
+        idle executor is cheaper than racing a shutdown."""
+        growing = len(shards) >= len(self.shards)
+        parallel = (
+            len(shards) > 1
+            and any(hasattr(s, "call") for s in shards)
+            and (os.cpu_count() or 1) > 1
+        )
+        pool = None
+        if parallel:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = ThreadPoolExecutor(max_workers=min(len(shards), 8))
+        node_w = np.asarray(meta.node_weight_sums, dtype=np.float64)
+        edge_w = np.asarray(meta.edge_weight_sums, dtype=np.float64)
+        self.meta = meta
+        if growing:
+            self.shards = list(shards)
+            self.num_shards = len(shards)
+            self._node_shard_w = node_w
+            self._edge_shard_w = edge_w
+        else:
+            self._node_shard_w = node_w
+            self._edge_shard_w = edge_w
+            self.num_shards = len(shards)
+            self.shards = list(shards)
+        self._dispatch_pool = pool
+        self._parallel_dispatch = parallel
+        self.topology_epoch += 1
+        return self.topology_epoch
 
     # -- construction ----------------------------------------------------
 
@@ -1108,16 +1159,20 @@ class Graph:
         `extras` are arrays aligned with `ids`, scattered the same way.
         """
         ids = np.asarray(ids, dtype=np.uint64)
-        if self.num_shards == 1 or len(ids) == 0:
-            return fn(self.shards[0], ids, *extras)
-        owner = self._owner(ids)
-        index = [
-            np.nonzero(owner == s)[0] for s in range(self.num_shards)
-        ]
-        if self._parallel_dispatch:
+        # ONE snapshot of the shards list per call: count, routing, and
+        # dispatch all derive from it, so a concurrent swap_topology can
+        # never tear this scatter across two topologies
+        shards = self.shards
+        num = len(shards)
+        pool = self._dispatch_pool
+        if num == 1 or len(ids) == 0:
+            return fn(shards[0], ids, *extras)
+        owner = (ids % np.uint64(num)).astype(np.int64)
+        index = [np.nonzero(owner == s)[0] for s in range(num)]
+        if pool is not None:
             futs = [
-                self._dispatch_pool.submit(
-                    fn, self.shards[s], ids[sel], *[e[sel] for e in extras]
+                pool.submit(
+                    fn, shards[s], ids[sel], *[e[sel] for e in extras]
                 )
                 if len(sel)
                 else None
@@ -1126,7 +1181,7 @@ class Graph:
             parts = [f.result() if f is not None else None for f in futs]
         else:
             parts = [
-                fn(self.shards[s], ids[sel], *[e[sel] for e in extras])
+                fn(shards[s], ids[sel], *[e[sel] for e in extras])
                 if len(sel)
                 else None
                 for s, sel in enumerate(index)
@@ -1157,36 +1212,44 @@ class Graph:
     def sample_node(self, count: int, node_type: int = -1, rng=None) -> np.ndarray:
         rng = _rng(rng)
         node_type = self.meta.node_type_id(node_type) if isinstance(node_type, str) else node_type
-        if self.num_shards == 1:
-            return self.shards[0].sample_node(count, node_type, rng)
+        # snapshot the shards list once (swap_topology race discipline):
+        # count, weights, and dispatch all derive from this one read
+        shards = self.shards
+        if len(shards) == 1:
+            return shards[0].sample_node(count, node_type, rng)
         w = (
             self._node_shard_w.sum(axis=1)
             if node_type < 0
             else self._node_shard_w[:, node_type]
         )
+        if len(w) != len(shards):  # mid-swap: weights lag one assignment
+            w = np.ones(len(shards), dtype=np.float64)
         picks = _WeightedSampler(w).sample(count, rng)
         out = np.empty(count, dtype=np.uint64)
-        for s in range(self.num_shards):
+        for s, sh in enumerate(shards):
             sel = picks == s
             if sel.any():
-                out[sel] = self.shards[s].sample_node(int(sel.sum()), node_type, rng)
+                out[sel] = sh.sample_node(int(sel.sum()), node_type, rng)
         return out
 
     def sample_edge(self, count: int, edge_type: int = -1, rng=None) -> np.ndarray:
         rng = _rng(rng)
-        if self.num_shards == 1:
-            return self.shards[0].sample_edge(count, edge_type, rng)
+        shards = self.shards
+        if len(shards) == 1:
+            return shards[0].sample_edge(count, edge_type, rng)
         w = (
             self._edge_shard_w.sum(axis=1)
             if edge_type < 0
             else self._edge_shard_w[:, edge_type]
         )
+        if len(w) != len(shards):  # mid-swap: weights lag one assignment
+            w = np.ones(len(shards), dtype=np.float64)
         picks = _WeightedSampler(w).sample(count, rng)
         out = np.empty((count, 3), dtype=np.uint64)
-        for s in range(self.num_shards):
+        for s, sh in enumerate(shards):
             sel = picks == s
             if sel.any():
-                out[sel] = self.shards[s].sample_edge(int(sel.sum()), edge_type, rng)
+                out[sel] = sh.sample_edge(int(sel.sum()), edge_type, rng)
         return out
 
     def node_type(self, ids) -> np.ndarray:
@@ -1203,20 +1266,21 @@ class Graph:
         if isinstance(node_type, str):
             node_type = self.meta.node_type_id(node_type)
         dnf = _fold_type(dnf, node_type)
-        if self.num_shards == 1:
-            return self.shards[0].sample_node_with_condition(count, dnf, -1, rng)
+        shards = self.shards
+        if len(shards) == 1:
+            return shards[0].sample_node_with_condition(count, dnf, -1, rng)
         # one DNF search per shard, reused for both the shard-weight draw and
         # the within-shard sample
-        results = [sh.search_condition(dnf) for sh in self.shards]
+        results = [sh.search_condition(dnf) for sh in shards]
         w = np.asarray([r.total_weight for r in results])
         if w.sum() <= 0:
             return np.full(count, DEFAULT_ID, dtype=np.uint64)
         picks = _WeightedSampler(w).sample(count, rng)
         out = np.full(count, DEFAULT_ID, dtype=np.uint64)
-        for s in range(self.num_shards):
+        for s, sh in enumerate(shards):
             sel = picks == s
             if sel.any():
-                out[sel] = self.shards[s].sample_from_result(
+                out[sel] = sh.sample_from_result(
                     results[s], int(sel.sum()), rng
                 )
         return out
@@ -1229,18 +1293,19 @@ class Graph:
         if isinstance(edge_type, str):
             edge_type = self.meta.edge_type_id(edge_type)
         dnf = _fold_type(dnf, edge_type)
-        if self.num_shards == 1:
-            return self.shards[0].sample_edge_with_condition(count, dnf, -1, rng)
-        results = [sh.search_condition(dnf, node=False) for sh in self.shards]
+        shards = self.shards
+        if len(shards) == 1:
+            return shards[0].sample_edge_with_condition(count, dnf, -1, rng)
+        results = [sh.search_condition(dnf, node=False) for sh in shards]
         w = np.asarray([r.total_weight for r in results])
         if w.sum() <= 0:
             return np.full((count, 3), DEFAULT_ID, dtype=np.uint64)
         picks = _WeightedSampler(w).sample(count, rng)
         out = np.full((count, 3), DEFAULT_ID, dtype=np.uint64)
-        for s in range(self.num_shards):
+        for s, sh in enumerate(shards):
             sel = picks == s
             if sel.any():
-                out[sel] = self.shards[s].sample_edges_from_result(
+                out[sel] = sh.sample_edges_from_result(
                     results[s], int(sel.sum()), rng
                 )
         return out
@@ -1248,12 +1313,13 @@ class Graph:
     def condition_mask(self, ids, dnf, node: bool = True) -> np.ndarray:
         if not node:
             ids = np.asarray(ids, dtype=np.uint64)
-            owner = (ids[:, 0] % np.uint64(self.num_shards)).astype(np.int64)
+            shards = self.shards
+            owner = (ids[:, 0] % np.uint64(len(shards))).astype(np.int64)
             out = np.zeros(len(ids), dtype=bool)
-            for s in range(self.num_shards):
+            for s, sh in enumerate(shards):
                 sel = owner == s
                 if sel.any():
-                    out[sel] = self.shards[s].condition_mask(
+                    out[sel] = sh.condition_mask(
                         ids[sel], dnf, node=False
                     )
             return out
@@ -1420,9 +1486,10 @@ class Graph:
                         raise
             # legacy: forward the whole query to one shard server
             # (spread coordinator load across shards)
-            pick = int(rng.integers(self.num_shards))
+            shards = self.shards
+            pick = int(rng.integers(len(shards)))
             try:
-                return self.shards[pick].fanout_with_rows(
+                return shards[pick].fanout_with_rows(
                     ids, edge_types, counts, rng
                 )
             except RuntimeError as e:

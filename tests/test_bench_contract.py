@@ -199,6 +199,18 @@ def test_bench_smoke_emits_final_json_line():
     assert row["retrieval_filtered_over_unfiltered"] > 0
     assert 0 <= row["retrieval_merge_overhead_pct"] <= 100
     assert row["retrieval_bit_parity"] is True, row
+    # the elastic-reshard lane (ISSUE 19) must not silently vanish
+    # (EULER_BENCH_RESHARD=0 is the opt-out — default is on): pure
+    # repartition throughput, the fence-to-commit cutover window, the
+    # writer-OBSERVED unavailability gap through a live 2 -> 3 split,
+    # and the resharded == from-scratch bit-parity oracle
+    assert row["reshard"] is True, row
+    assert row["reshard_bit_parity"] is True, row
+    assert row["reshard_rows_per_sec"] > 0
+    assert row["reshard_cutover_ms"] > 0
+    # the client kept writing through the cutover: the observed gap is
+    # bounded (a few lease TTLs), not a stop-the-world migration
+    assert 0 < row["reshard_unavail_ms"] < 60_000, row
     # the serving lane rode along: its own JSON line with latency
     # percentiles and the coalescing ratio, plus a summary on the
     # re-emitted headline

@@ -12,6 +12,7 @@ import pytest
 
 from euler_tpu.analytics import primitives as analytics_primitives
 from euler_tpu.distributed import replication
+from euler_tpu.distributed import reshard
 from euler_tpu.graph import backup
 from euler_tpu.distributed.client import RemoteShard
 from euler_tpu.distributed.service import GraphService
@@ -29,6 +30,7 @@ def test_graph_domain_tables_match():
         | set(analytics_primitives.WIRE_VERBS)
         | set(replication.WIRE_VERBS)
         | set(backup.WIRE_VERBS)
+        | set(reshard.WIRE_VERBS)
     )
     assert client_verbs == set(GraphService.HANDLED_VERBS), (
         "graph-protocol verb tables diverged:\n"
@@ -278,6 +280,100 @@ def test_backup_scrub_surface_stays_inside_its_table(monkeypatch):
     stray = set(sent) - set(backup.WIRE_VERBS)
     assert not stray, f"scrubber sent undeclared verbs: {sorted(stray)}"
     assert {"scrub", "wal_ship"} <= set(sent)
+
+
+def test_reshard_coordinator_surface_stays_inside_its_table(
+    tmp_path, monkeypatch, fixture_graph_dict
+):
+    """Runtime twin for the reshard lane (ISSUE 19): a full coordinator
+    run (plan -> copy -> catch_up -> cutover -> commit) plus the abort/
+    unfence path over a recording source proves every verb the
+    coordinator puts on the wire is in reshard.WIRE_VERBS."""
+    import collections
+
+    from euler_tpu.distributed import codec
+    from euler_tpu.graph import wal as walmod
+    from euler_tpu.graph.builder import build_from_json
+
+    monkeypatch.delenv("EULER_TPU_RESHARD_KILL_AT", raising=False)
+    meta, parts = build_from_json(fixture_graph_dict, 1)
+    sent = []
+
+    class _Recording:
+        shard = 0
+
+        def call(self, op, values, deadline_s=None, prefer=None):
+            sent.append(op)
+            if op == "get_meta":
+                return [json.dumps(meta.to_dict())]
+            if op == "stats":
+                return [json.dumps({"topology_epoch": 0})]
+            if op == "publish_epoch":
+                return [1, np.empty(0, np.int64), np.empty(0, np.uint64), 1]
+            if op == "wal_ship" and values[3] == "snapshot":
+                arrays = parts[0]
+                names = sorted(arrays)
+                head = {
+                    "v": 2,
+                    "codec": "id",
+                    "names": names,
+                    "dtypes": [str(arrays[n].dtype) for n in names],
+                    "shapes": [list(arrays[n].shape) for n in names],
+                }
+                blobs = [
+                    np.frombuffer(
+                        codec.compress(
+                            "id", np.ascontiguousarray(arrays[n]).tobytes()
+                        ),
+                        np.uint8,
+                    )
+                    for n in names
+                ]
+                applied = np.frombuffer(
+                    codec.compress(
+                        "id",
+                        bytes(
+                            walmod._applied_blob(collections.OrderedDict())
+                        ),
+                    ),
+                    np.uint8,
+                )
+                return [0, 1, 0, applied, json.dumps(head)] + blobs
+            if op == "wal_ship":
+                return [0, np.empty(0, np.uint8), int(values[0]), False]
+            if op == "wal_pos":
+                return [0, 0, 0, 1]
+            if op == "fence":
+                return [1, 0, 1]
+            if op == "unfence":
+                return [True]
+            if op == "ping":
+                return [0]
+            raise AssertionError(f"unexpected verb {op!r}")
+
+    co = reshard.ReshardCoordinator(
+        str(tmp_path / "reg"), 1, 2, str(tmp_path / "state")
+    )
+    co._src_handles = [_Recording()]
+    monkeypatch.setattr(co, "_spawn_dests", lambda data_dir: [])
+    monkeypatch.setattr(co, "_await_dests", lambda epoch: {})
+    report = co.run()
+    assert report["outcome"] == "done"
+
+    # the abort path sends unfence to a fenced source
+    co2 = reshard.ReshardCoordinator(
+        str(tmp_path / "reg2"), 1, 2, str(tmp_path / "state2")
+    )
+    co2._src_handles = [_Recording()]
+    co2.log.append("fence_begin", token=co2.token)
+    co2._abort("runtime-twin")
+
+    stray = set(sent) - set(reshard.WIRE_VERBS)
+    assert not stray, f"coordinator sent undeclared verbs: {sorted(stray)}"
+    assert {
+        "get_meta", "stats", "publish_epoch", "wal_ship", "wal_pos",
+        "fence", "unfence",
+    } <= set(sent)
 
 
 # --- retrieval domain (ISSUE 17) -------------------------------------------
